@@ -1,0 +1,53 @@
+"""distributed_tensorflow_tpu: a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``yaokeepmoving/distributed_tensorflow`` (a distributed-TensorFlow training
+repo driving tf.distribute over NCCL/gRPC — see SURVEY.md for the full
+structural analysis).  Nothing here is a port: on TPU a collective is an HLO
+op compiled into the program and executed over ICI, not a runtime service, so
+TF's L1–L4 layers (gRPC runtime, C++ collective executor, collective ops,
+CrossDeviceOps) collapse into XLA.  What survives is the *user contract*:
+
+- ``tf.distribute.Strategy``-shaped strategies (``parallel.strategy``) whose
+  scope/run/reduce semantics lower to ``jax.jit`` + ``NamedSharding`` /
+  ``shard_map`` collectives over a device mesh.
+- ``TF_CONFIG`` / ``ClusterSpec`` / ``--job_name --task_index`` launcher
+  compatibility (``cluster``), resolving to ``jax.distributed.initialize``
+  and a TPU pod-slice topology instead of GPU hosts.
+- Parameter-server *semantics* (huge sharded embedding tables, coordinator
+  dispatch) without the PS runtime (``parallel.embedding``,
+  ``parallel.coordinator``).
+- Checkpoint/resume (orbax), preemption-aware fault tolerance, profiling,
+  metrics, and the five reference workloads (MNIST CNN, ResNet-50, BERT,
+  Wide&Deep/DLRM, GPT-2) as first-class model families.
+"""
+
+from distributed_tensorflow_tpu.version import __version__
+
+# Submodules are imported lazily via attribute access so that importing the
+# top-level package stays cheap (no flax/optax import cost until needed).
+_SUBMODULES = (
+    "cluster",
+    "parallel",
+    "ops",
+    "models",
+    "data",
+    "checkpoint",
+    "training",
+    "ft",
+    "utils",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f"distributed_tensorflow_tpu.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

@@ -1,0 +1,95 @@
+"""Cluster-wide coordination: barriers, broadcast, and consistency guards.
+
+Behavioral model: TF's coordination service ($INC/distributed_runtime/
+coordination/coordination_client.h, configured via
+``context.configure_coordination_service``, $TF/python/eager/context.py:903 —
+SURVEY.md §3.2) which provides cluster membership, health, and a distributed
+KV/barrier.  JAX ships the same concept inside ``jax.distributed``; here we
+wrap the pieces training code needs, and add the cross-host
+**collective-mismatch guard** SURVEY.md §6.2 calls for: since an XLA program's
+collective schedule is static, the remaining failure mode is different hosts
+compiling *different* programs — caught by hashing program/sharding fingerprints
+at init and comparing across hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that plays TF's "chief" role."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cluster-wide sync barrier (TF: coordination-service WaitAtBarrier)."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_coordinator(value: Any) -> Any:
+    """Broadcast a pytree of host values from process 0 to all processes."""
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+def fingerprint(obj: Any) -> str:
+    """Stable hash of a jsonable/pytree-of-shapes object."""
+
+    def _canon(x):
+        if isinstance(x, (np.ndarray, jax.Array)):
+            return ("array", str(x.dtype), tuple(x.shape))
+        if hasattr(x, "dtype") and hasattr(x, "shape"):
+            return ("array", str(x.dtype), tuple(x.shape))
+        return x
+
+    leaves, treedef = jax.tree.flatten(obj)
+    payload = json.dumps(
+        [str(treedef)] + [repr(_canon(l)) for l in leaves], sort_keys=True
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def assert_same_program(tag: str, obj: Any) -> None:
+    """Collective-mismatch guard (SURVEY.md §6.2).
+
+    Hashes ``obj`` (e.g. abstract shapes+shardings of the train state, or an
+    HLO text) on every host and verifies all hosts agree before any collective
+    runs.  Raises on divergence — turning a would-be silent deadlock or
+    data-corrupting mismatch into a loud init-time error.  TF achieves the
+    runtime half of this with CollectiveKeys + ordering tokens
+    ($TF/python/distribute/cross_device_utils.py:173,:370).
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    fp = fingerprint(obj)
+    digest = np.frombuffer(bytes.fromhex(fp), dtype=np.uint8)
+    reference = multihost_utils.broadcast_one_to_all(digest)
+    if not np.array_equal(digest, np.asarray(reference)):
+        raise RuntimeError(
+            f"Collective-mismatch guard {tag!r}: process {jax.process_index()} "
+            f"computed a different program fingerprint than the coordinator. "
+            f"All hosts must build identical programs/shardings."
+        )

@@ -1,0 +1,172 @@
+"""Cluster resolvers: discover the cluster topology from the environment.
+
+Behavioral model: ``$TF/python/distribute/cluster_resolver/`` (SURVEY.md
+§3.3) — ``ClusterResolver`` base, ``SimpleClusterResolver``, and
+``TFConfigClusterResolver`` which parses the ``TF_CONFIG`` JSON env var
+(``{"cluster": {...}, "task": {"type": ..., "index": ...}}``,
+$TF/python/distribute/cluster_resolver/tfconfig_cluster_resolver.py:25).
+
+The reference's train.py entrypoints are launched either with ``TF_CONFIG``
+set (TF2 MultiWorkerMirroredStrategy path) or with ``--job_name/--task_index``
+flags (TF1 PS launcher path); both resolve here to the same ``ClusterSpec``
+and from there to ``jax.distributed.initialize`` (see ``cluster.server``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+
+from distributed_tensorflow_tpu.cluster.cluster_spec import ClusterSpec
+
+
+class ClusterResolver:
+    """Base class. Subclasses discover topology from their environment."""
+
+    task_type: Optional[str] = None
+    task_id: Optional[int] = None
+
+    def cluster_spec(self) -> ClusterSpec:
+        raise NotImplementedError
+
+    def master(self, task_type: Optional[str] = None, task_id: Optional[int] = None) -> str:
+        """Address of the coordination leader (TF: the session master)."""
+        spec = self.cluster_spec()
+        if task_type is not None and task_id is not None:
+            return spec.task_address(task_type, task_id)
+        if not spec:
+            return ""
+        return spec.coordinator_address()
+
+    def num_accelerators(self) -> int:
+        """Local accelerator count (TF returns a per-type dict; we count chips)."""
+        return len([d for d in jax.local_devices() if d.platform != "cpu"])
+
+    @property
+    def environment(self) -> str:
+        return ""
+
+    # -- TPU-native extension: everything jax.distributed needs --------------
+    def process_id(self) -> int:
+        spec = self.cluster_spec()
+        if not spec or self.task_type is None:
+            return 0
+        return spec.process_id(self.task_type, self.task_id or 0)
+
+    def num_processes(self) -> int:
+        spec = self.cluster_spec()
+        return spec.num_processes() if spec else 1
+
+    def is_compute_task(self) -> bool:
+        """False for ps/evaluator tasks, which do not join the mesh."""
+        from distributed_tensorflow_tpu.cluster.cluster_spec import COMPUTE_JOBS
+
+        return self.task_type is None or self.task_type in COMPUTE_JOBS
+
+
+class SimpleClusterResolver(ClusterResolver):
+    """Wraps an explicit ClusterSpec ($TF .../cluster_resolver.py:289)."""
+
+    def __init__(
+        self,
+        cluster_spec: ClusterSpec,
+        task_type: Optional[str] = None,
+        task_id: Optional[int] = None,
+        environment: str = "",
+    ):
+        self._cluster_spec = ClusterSpec(cluster_spec)
+        self.task_type = task_type
+        self.task_id = task_id
+        self._environment = environment
+
+    def cluster_spec(self) -> ClusterSpec:
+        return self._cluster_spec
+
+    @property
+    def environment(self) -> str:
+        return self._environment
+
+
+class TFConfigClusterResolver(ClusterResolver):
+    """Reads cluster config from the ``TF_CONFIG`` environment variable.
+
+    ($TF .../tfconfig_cluster_resolver.py:48.)  An empty/missing TF_CONFIG
+    resolves to an empty cluster (single-process training), exactly like TF.
+    """
+
+    def __init__(
+        self,
+        task_type: Optional[str] = None,
+        task_id: Optional[int] = None,
+        environ: Optional[dict] = None,
+    ):
+        self._environ = environ if environ is not None else os.environ
+        cfg = self._load()
+        task = cfg.get("task", {})
+        self.task_type = task_type if task_type is not None else task.get("type")
+        self.task_id = task_id if task_id is not None else (
+            int(task["index"]) if "index" in task else None
+        )
+
+    def _load(self) -> dict:
+        raw = self._environ.get("TF_CONFIG", "")
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"TF_CONFIG is not valid JSON: {raw!r}") from e
+
+    def cluster_spec(self) -> ClusterSpec:
+        return ClusterSpec(self._load().get("cluster", {}))
+
+    @property
+    def environment(self) -> str:
+        return self._load().get("environment", "")
+
+
+class TPUClusterResolver(ClusterResolver):
+    """Resolves the local TPU slice topology.
+
+    TF's TPUClusterResolver talks to the Cloud TPU API / metadata server
+    ($TF .../tpu_cluster_resolver.py); on a pod-slice VM JAX already knows its
+    own topology, so this resolver simply reflects what the runtime reports.
+    Multi-host pod slices still set TF_CONFIG or use jax.distributed's
+    auto-detection; this class answers "what accelerators does this process
+    see" for strategy constructors.
+    """
+
+    def __init__(self, tpu: Optional[str] = None):
+        self._tpu = tpu or ""
+        self.task_type = None
+        self.task_id = None
+
+    def cluster_spec(self) -> ClusterSpec:
+        return ClusterSpec({})
+
+    def num_accelerators(self) -> int:
+        return len([d for d in jax.local_devices() if d.platform != "cpu"])
+
+    @property
+    def environment(self) -> str:
+        return "tpu"
+
+
+def resolve(
+    job_name: Optional[str] = None,
+    task_index: Optional[int] = None,
+    cluster_spec: Optional[ClusterSpec] = None,
+) -> ClusterResolver:
+    """One-stop resolution implementing the reference launcher contract.
+
+    Priority: explicit ClusterSpec > TF_CONFIG env > single-process.
+    ``--job_name/--task_index`` flags override the task identity either way
+    (the TF1 PS-launcher contract, SURVEY.md §4.2).
+    """
+    if cluster_spec is not None:
+        return SimpleClusterResolver(cluster_spec, job_name, task_index)
+    resolver = TFConfigClusterResolver(task_type=job_name, task_id=task_index)
+    return resolver

@@ -1,0 +1,125 @@
+"""In-process server: the ``tf.distribute.Server`` contract on JAX runtime.
+
+Behavioral model: ``$TF/python/training/server_lib.py:96`` (``Server``) — the
+reference's PS launcher starts one process per task with
+``--job_name={ps|worker} --task_index=i``; each constructs a Server from the
+ClusterSpec; ps tasks call ``server.join()`` and workers train (SURVEY.md
+§4.2).
+
+TPU-native translation: there is no gRPC data plane to serve.  A *compute*
+task (chief/worker) joins the JAX multi-process runtime via
+``jax.distributed.initialize`` — process 0 additionally hosts the built-in
+coordination service (the C++ GrpcServer's surviving role).  A *ps* task has
+no tensors to serve (parameters are mesh-sharded, SURVEY.md §4.4), so
+``join()`` parks the process until shutdown, keeping launcher scripts that
+expect blocking ps processes working unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import jax
+
+from distributed_tensorflow_tpu.cluster.cluster_spec import (
+    COMPUTE_JOBS,
+    ClusterSpec,
+)
+from distributed_tensorflow_tpu.cluster.resolver import ClusterResolver
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+_INIT_LOCK = threading.Lock()
+
+
+def initialize_runtime(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotent wrapper over ``jax.distributed.initialize``.
+
+    (jax/_src/distributed.py:215 — the TPU-native replacement for starting a
+    ``GrpcServer``; SURVEY.md §2 L1.)  Single-process callers skip it.
+    """
+    global _INITIALIZED
+    with _INIT_LOCK:
+        if num_processes is None or num_processes <= 1:
+            # Nothing to do for single-process; deliberately do NOT latch
+            # _INITIALIZED so a later real multi-process init still runs.
+            return
+        if _INITIALIZED:
+            return
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+
+
+class Server:
+    """API-compatible with ``tf.distribute.Server`` for launcher scripts."""
+
+    def __init__(
+        self,
+        cluster: ClusterSpec,
+        job_name: str = "worker",
+        task_index: int = 0,
+        start: bool = True,
+    ):
+        self.cluster_spec = ClusterSpec(cluster)
+        self.job_name = job_name
+        self.task_index = task_index
+        self._started = False
+        self._shutdown = threading.Event()
+        if start:
+            self.start()
+
+    @classmethod
+    def from_resolver(cls, resolver: ClusterResolver, start: bool = True) -> "Server":
+        return cls(
+            resolver.cluster_spec(),
+            job_name=resolver.task_type or "worker",
+            task_index=resolver.task_id or 0,
+            start=start,
+        )
+
+    @property
+    def is_compute(self) -> bool:
+        return self.job_name in COMPUTE_JOBS
+
+    @property
+    def target(self) -> str:
+        """TF's session target. Kept for API parity; meaningless under XLA."""
+        return f"jax://{self.cluster_spec.task_address(self.job_name, self.task_index)}"
+
+    def start(self) -> None:
+        if self._started:
+            return
+        if self.is_compute and self.cluster_spec.num_processes() > 1:
+            initialize_runtime(
+                coordinator_address=self.cluster_spec.coordinator_address(),
+                num_processes=self.cluster_spec.num_processes(),
+                process_id=self.cluster_spec.process_id(
+                    self.job_name, self.task_index
+                ),
+            )
+        elif not self.is_compute:
+            logger.info(
+                "Task %s:%d is not a compute job; parameters are mesh-sharded "
+                "on TPU, so this process only parks in join().",
+                self.job_name,
+                self.task_index,
+            )
+        self._started = True
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block like a TF ps task does. Returns early only on shutdown()."""
+        self._shutdown.wait(timeout=timeout)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()

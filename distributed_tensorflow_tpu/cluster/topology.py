@@ -1,0 +1,136 @@
+"""TPU topology and device-mesh construction.
+
+Behavioral model: ``$TF/python/tpu/topology.py:41`` (``Topology``) and
+``device_assignment.py:70`` (``DeviceAssignment``) — device coordinates and
+logical→physical mapping (SURVEY.md §3.3).  In JAX the equivalent artifact is
+a ``jax.sharding.Mesh``: a named, N-dimensional arrangement of devices that
+shardings and collectives refer to by axis name.
+
+Canonical mesh axes (every parallelism form is a named axis; SURVEY.md §8):
+
+- ``data``     pure data parallelism (gradient allreduce; MWMS equivalent)
+- ``fsdp``     data parallelism with sharded params/optimizer (ZeRO-3 style)
+- ``tensor``   tensor/model parallelism (megatron-style within attention/MLP)
+- ``pipe``     pipeline stages (net-new vs reference, SURVEY.md §3.1 "PP")
+- ``context``  sequence/context parallelism (ring attention KV rotation)
+- ``expert``   expert / embedding-shard parallelism (PS-embedding equivalent)
+
+Axes of size 1 are kept in the mesh so sharding rules can always name them;
+XLA elides trivial collectives, so unused axes are free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import AxisType, Mesh
+
+# Order matters: outer→inner. ``data`` outermost maps replicas across hosts
+# (gradient allreduce rides DCN between slices at worst), while ``tensor`` and
+# ``context`` innermost keep their heavy collectives on the ICI torus — the
+# scaling-book layout recipe.
+MESH_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor", "pipe", "context", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape over the global device set.
+
+    Any axis left at 1 is inert. ``data=-1`` means "absorb all remaining
+    devices" (the common case: shard everything else explicitly, data-parallel
+    over whatever is left).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    context: int = 1
+    expert: int = 1
+
+    def axis_sizes(self, num_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in MESH_AXES}
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"At most one axis may be -1, got {wild}")
+        if wild:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[wild[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"Mesh {sizes} needs {fixed} devices but {num_devices} present"
+            )
+        return sizes
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        return build_mesh(self, devices)
+
+
+def build_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all global devices).
+
+    Uses ``mesh_utils.create_device_mesh`` so physical ICI topology (the v5e
+    2D torus / pod 3D torus) is honored when assigning logical coordinates —
+    the role TF's ``device_assignment()`` ($TF/python/tpu/device_assignment.py:343)
+    plays for tpu.replicate.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    if len(devices) == 1:
+        dev_array = np.array(devices).reshape(shape)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, NotImplementedError):
+            # CPU test meshes and odd shapes: fall back to row-major layout.
+            dev_array = np.array(devices).reshape(shape)
+    return Mesh(
+        dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
+    )
+
+
+def single_axis_mesh(
+    axis: str = "data", devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """All devices on one named axis (pure-DP MultiWorkerMirrored shape)."""
+    overrides = {} if axis == "data" else {"data": 1, axis: -1}
+    return build_mesh(MeshConfig(**overrides), devices)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Summary of the physical device topology, TF-Topology-shaped."""
+
+    num_devices: int
+    num_hosts: int
+    devices_per_host: int
+    platform: str
+    device_kind: str
+
+    @classmethod
+    def detect(cls) -> "Topology":
+        devs = jax.devices()
+        return cls(
+            num_devices=len(devs),
+            num_hosts=jax.process_count(),
+            devices_per_host=len(jax.local_devices()),
+            platform=devs[0].platform,
+            device_kind=devs[0].device_kind,
+        )

@@ -1,0 +1,33 @@
+"""Parallelism: collectives, sharding, strategies, and parallel forms.
+
+TPU-native replacement for the reference stack's L3–L5 (SURVEY.md §2):
+distribution strategies, CrossDeviceOps, and collective launch all lower to
+XLA over a named device mesh.
+"""
+
+from distributed_tensorflow_tpu.parallel import collectives, sharding
+from distributed_tensorflow_tpu.parallel.sharding import (
+    FixedShardsPartitioner,
+    MaxSizePartitioner,
+    MinSizePartitioner,
+    P,
+    ShardingRules,
+    apply_shardings,
+    batch_sharding,
+    fsdp_sharding,
+    replicated,
+    transformer_rules,
+)
+
+_LAZY = ("strategy", "values", "coordinator", "embedding", "pipeline",
+         "ring_attention")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(f"distributed_tensorflow_tpu.parallel.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,142 @@
+"""Named-axis collective primitives — the framework's single collectives home.
+
+Behavioral model: the reference stack's four-layer collective machinery
+(SURVEY.md §3.2): ``CrossDeviceOps``/``CollectiveAllReduce``
+($TF/python/distribute/cross_device_ops.py:252,:1045),
+``CollectiveReplicaLauncher`` (cross_device_utils.py:274), graph-level
+``collective_ops.all_reduce_v2`` (collective_ops.py:95), and the C++
+executor + NCCL manager underneath.  On TPU that entire stack is one HLO op:
+a collective here is ``jax.lax.psum``/``all_gather``/… inside ``shard_map``
+(or implicit via jit+NamedSharding), compiled by XLA into an ICI DMA.  There
+is no group/instance-key bookkeeping, no launch ordering tokens, no NCCL —
+the schedule is static in the compiled program.
+
+These wrappers exist so the rest of the framework never scatter-calls
+``jax.lax`` directly: one place to audit axis usage, add sparse (IndexedSlices
+-equivalent) handling, and keep gradient-bucketing policy
+(``_ConcatAndSplitPacker``'s role is XLA's all-reduce combiner; see
+``xla_allreduce_combine_bytes`` below).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+PyTree = Any
+
+
+# -- dense collectives (CollectiveAllReduce / all_reduce_v2 equivalents) -----
+
+def psum(tree: PyTree, axis: AxisName) -> PyTree:
+    """All-reduce sum over a named mesh axis (HLO AllReduce on ICI)."""
+    return jax.tree.map(lambda x: lax.psum(x, axis), tree)
+
+
+def pmean(tree: PyTree, axis: AxisName) -> PyTree:
+    """All-reduce mean — the gradient-sync op of sync data parallelism
+    (MultiWorkerMirroredStrategy's reduce, SURVEY.md §4.1)."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
+
+
+def pmax(tree: PyTree, axis: AxisName) -> PyTree:
+    return jax.tree.map(lambda x: lax.pmax(x, axis), tree)
+
+
+def pmin(tree: PyTree, axis: AxisName) -> PyTree:
+    return jax.tree.map(lambda x: lax.pmin(x, axis), tree)
+
+
+def all_gather(
+    tree: PyTree, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True
+) -> PyTree:
+    """All-gather over a named axis (collective_ops.all_gather_v2 equiv)."""
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis, axis=gather_axis, tiled=tiled), tree
+    )
+
+
+def reduce_scatter(
+    tree: PyTree, axis: AxisName, *, scatter_axis: int = 0
+) -> PyTree:
+    """Reduce-scatter (NcclManager::AddToReduceScatter equiv; the FSDP
+    gradient op)."""
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                   tiled=True),
+        tree,
+    )
+
+
+def ppermute(tree: PyTree, axis: str, perm: Sequence[tuple]) -> PyTree:
+    """Point-to-point permutation (HLO CollectivePermute) — the ICI
+    device-to-device transfer that replaces the gRPC RecvTensor rendezvous
+    (north star; SURVEY.md §3.2 "RecvTensor").  Building block for ring
+    attention and pipeline stage hand-off."""
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def ring_shift(tree: PyTree, axis: str, axis_size: int, shift: int = 1) -> PyTree:
+    """Rotate values around the axis ring by ``shift`` positions."""
+    perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
+    return ppermute(tree, axis, perm)
+
+
+def all_to_all(
+    tree: PyTree, axis: AxisName, *, split_axis: int, concat_axis: int
+) -> PyTree:
+    """All-to-all — the embedding-exchange op (TPUEmbedding-style lookup
+    routing, SURVEY.md §4.4) and the Ulysses sequence-parallel primitive."""
+    return jax.tree.map(
+        lambda x: lax.all_to_all(
+            x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+        ),
+        tree,
+    )
+
+
+def broadcast(tree: PyTree, axis: AxisName, root: int = 0) -> PyTree:
+    """Broadcast from ``root`` along ``axis`` (broadcast_send_v2/recv_v2
+    equiv, $TF/python/ops/collective_ops.py:314,:392).  Implemented as a
+    select+psum: cheap at HLO level, no special op needed."""
+
+    def _bcast(x):
+        idx = lax.axis_index(axis)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis)
+
+    return jax.tree.map(_bcast, tree)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+# -- sparse gradients (IndexedSlices allreduce equivalent) -------------------
+
+def psum_sparse(
+    values: jax.Array, indices: jax.Array, axis: AxisName, *, dense_size: int
+) -> jax.Array:
+    """All-reduce of a sparse (indices, values) gradient into dense form.
+
+    TF's ``all_reduce_indexed_slices`` (cross_device_utils.py:516) allgathers
+    indices+values; on TPU the idiomatic lowering is scatter-into-dense then
+    AllReduce — XLA fuses the scatter, and the dense AllReduce rides ICI.
+    Used for embedding-gradient sync when tables are *replicated*; sharded
+    tables (parallel.embedding) never materialize dense gradients at all.
+    """
+    dense = jnp.zeros((dense_size,) + values.shape[1:], values.dtype)
+    dense = dense.at[indices].add(values)
+    return lax.psum(dense, axis)
+
+
+# NOTE on gradient packing/bucketing: the role of TF's _ConcatAndSplitPacker
+# (cross_device_ops.py:712) — packing many small gradient tensors into few
+# big collectives — is performed by XLA's all-reduce combiner pass, which is
+# on by default on TPU with a tuned threshold.  There is deliberately no knob
+# here: the pass has no stable public TPU flag, and exposing a GPU-only flag
+# would be a silent no-op on the target platform.

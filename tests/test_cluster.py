@@ -1,0 +1,147 @@
+"""Tests for cluster spec / resolvers / topology (SURVEY.md §3.3 parity)."""
+
+import json
+
+import jax
+import pytest
+
+from distributed_tensorflow_tpu.cluster import (
+    ClusterSpec,
+    MeshConfig,
+    SimpleClusterResolver,
+    Server,
+    TFConfigClusterResolver,
+    Topology,
+    build_mesh,
+    resolve,
+    single_axis_mesh,
+)
+
+
+class TestClusterSpec:
+    def test_from_dict_lists(self):
+        spec = ClusterSpec({"ps": ["ps0:2222", "ps1:2222"],
+                            "worker": ["w0:2222", "w1:2222", "w2:2222"]})
+        assert spec.jobs == ["ps", "worker"]
+        assert spec.num_tasks("worker") == 3
+        assert spec.task_address("ps", 1) == "ps1:2222"
+        assert spec.job_tasks("worker") == ["w0:2222", "w1:2222", "w2:2222"]
+
+    def test_from_dict_mapping_and_roundtrip(self):
+        spec = ClusterSpec({"worker": {0: "a:1", 2: "c:3"}})
+        assert spec.task_indices("worker") == [0, 2]
+        assert spec.as_dict() == {"worker": {0: "a:1", 2: "c:3"}}
+        assert ClusterSpec(spec) == spec
+
+    def test_unknown_job_raises(self):
+        spec = ClusterSpec({"worker": ["w0:1"]})
+        with pytest.raises(ValueError):
+            spec.num_tasks("ps")
+        with pytest.raises(ValueError):
+            spec.task_address("worker", 5)
+
+    def test_process_mapping_excludes_ps(self):
+        spec = ClusterSpec({"chief": ["c:1"], "worker": ["w0:1", "w1:1"],
+                            "ps": ["p0:1"]})
+        assert spec.num_processes() == 3
+        assert spec.process_id("chief", 0) == 0
+        assert spec.process_id("worker", 1) == 2
+        assert spec.process_id("ps", 0) == -1
+        assert spec.coordinator_address() == "c:1"
+
+    def test_process_id_sparse_indices_match_compute_tasks(self):
+        spec = ClusterSpec({"chief": ["c:1"], "worker": {0: "w0:1", 2: "w2:1"}})
+        assert spec.num_processes() == 3
+        # Ranks must be dense 0..n-1 in compute_tasks() order.
+        assert spec.process_id("chief", 0) == 0
+        assert spec.process_id("worker", 0) == 1
+        assert spec.process_id("worker", 2) == 2
+
+    def test_process_id_absent_task_raises(self):
+        spec = ClusterSpec({"worker": ["w0:1", "w1:1"]})
+        with pytest.raises(ValueError):
+            spec.process_id("chief", 0)
+        with pytest.raises(ValueError):
+            spec.process_id("worker", 5)
+
+
+class TestResolvers:
+    def test_tf_config_resolver(self):
+        env = {"TF_CONFIG": json.dumps({
+            "cluster": {"worker": ["w0:1", "w1:1"]},
+            "task": {"type": "worker", "index": 1},
+        })}
+        r = TFConfigClusterResolver(environ=env)
+        assert r.task_type == "worker"
+        assert r.task_id == 1
+        assert r.cluster_spec().num_tasks("worker") == 2
+        assert r.process_id() == 1
+        assert r.num_processes() == 2
+        assert r.master() == "w0:1"
+
+    def test_empty_tf_config_is_single_process(self):
+        r = TFConfigClusterResolver(environ={})
+        assert not r.cluster_spec()
+        assert r.num_processes() == 1
+        assert r.process_id() == 0
+
+    def test_flag_override(self):
+        env = {"TF_CONFIG": json.dumps({
+            "cluster": {"worker": ["w0:1", "w1:1"]},
+            "task": {"type": "worker", "index": 0},
+        })}
+        r = TFConfigClusterResolver(task_type="worker", task_id=1, environ=env)
+        assert r.task_id == 1
+
+    def test_simple_resolver_ps_not_compute(self):
+        spec = ClusterSpec({"worker": ["w:1"], "ps": ["p:1"]})
+        r = SimpleClusterResolver(spec, task_type="ps", task_id=0)
+        assert not r.is_compute_task()
+
+    def test_resolve_single_process_default(self):
+        r = resolve()
+        assert r.num_processes() >= 1
+
+
+class TestServer:
+    def test_ps_server_join_unblocks_on_shutdown(self):
+        spec = ClusterSpec({"worker": ["w:1"], "ps": ["p:1"]})
+        server = Server(spec, job_name="ps", task_index=0)
+        assert not server.is_compute
+        server.shutdown()
+        server.join(timeout=5)  # must return immediately
+
+    def test_single_worker_server_starts_without_distributed_init(self):
+        spec = ClusterSpec({"worker": ["localhost:1"]})
+        server = Server(spec, job_name="worker", task_index=0)
+        assert server.is_compute
+        assert server.target.startswith("jax://")
+
+
+class TestMesh:
+    def test_default_mesh_all_data(self, devices8):
+        mesh = build_mesh(MeshConfig(), devices8)
+        assert mesh.shape["data"] == 8
+        assert all(mesh.shape[a] == 1 for a in mesh.shape if a != "data")
+
+    def test_wildcard_and_fixed_axes(self, devices8):
+        mesh = build_mesh(MeshConfig(data=-1, tensor=2, context=2), devices8)
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["tensor"] == 2
+        assert mesh.shape["context"] == 2
+
+    def test_bad_factorization_raises(self, devices8):
+        with pytest.raises(ValueError):
+            build_mesh(MeshConfig(data=3, tensor=2), devices8)
+        with pytest.raises(ValueError):
+            build_mesh(MeshConfig(data=5), devices8)
+
+    def test_single_axis_mesh(self, devices8):
+        mesh = single_axis_mesh("tensor", devices8)
+        assert mesh.shape["tensor"] == 8
+        assert mesh.shape["data"] == 1
+
+    def test_topology_detect(self):
+        topo = Topology.detect()
+        assert topo.num_devices == 8
+        assert topo.platform == "cpu"

@@ -1,0 +1,94 @@
+"""Tests for sharding rules / partitioners (SURVEY.md §3.1, §3.4 parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import (
+    FixedShardsPartitioner,
+    MaxSizePartitioner,
+    MinSizePartitioner,
+    ShardingRules,
+    apply_shardings,
+    batch_sharding,
+    fsdp_sharding,
+    transformer_rules,
+)
+
+
+class TestShardingRules:
+    def test_first_match_wins_and_default_replicated(self):
+        rules = ShardingRules([
+            (r"kernel$", P("fsdp", "tensor")),
+            (r".*", P("data")),
+        ])
+        assert rules.spec_for("dense/kernel", (128, 256)) == P("fsdp", "tensor")
+        assert rules.spec_for("dense/bias", (256,)) == P("data")
+        assert ShardingRules().spec_for("anything", (4,)) == P()
+
+    def test_spec_trimmed_to_rank(self):
+        rules = ShardingRules([(r"kernel", P("fsdp", "tensor"))])
+        assert rules.spec_for("kernel", (128,)) == P("fsdp")
+
+    def test_shardings_for_tree(self, mesh_2d):
+        rules = ShardingRules([(r"kernel", P(None, "tensor"))])
+        tree = {"layer": {"kernel": jnp.ones((4, 8)), "bias": jnp.ones((8,))}}
+        sh = rules.shardings_for(mesh_2d, tree)
+        assert sh["layer"]["kernel"].spec == P(None, "tensor")
+        assert sh["layer"]["bias"].spec == P()
+        placed = apply_shardings(tree, sh)
+        np.testing.assert_allclose(np.asarray(placed["layer"]["kernel"]),
+                                   np.ones((4, 8)))
+
+    def test_transformer_rules_cover_canonical_paths(self):
+        rules = transformer_rules()
+        assert rules.spec_for("transformer/h_0/attn/c_attn/kernel", (768, 2304)) \
+            == P("fsdp", "tensor")
+        assert rules.spec_for("transformer/h_0/mlp/c_fc/kernel", (768, 3072)) \
+            == P("fsdp", "tensor")
+        assert rules.spec_for("wte/embedding", (50257, 768)) == P("tensor", "fsdp")
+        assert rules.spec_for("h_0/ln_1/scale", (768,)) == P()
+
+
+class TestFsdpSharding:
+    def test_large_params_sharded_small_replicated(self, devices8):
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8), devices8)
+        tree = {"big": jnp.ones((1024, 64)), "small": jnp.ones((4, 4))}
+        sh = fsdp_sharding(mesh, tree)
+        assert sh["big"].spec == P("fsdp")
+        assert sh["small"].spec == P()
+
+    def test_indivisible_falls_back_to_replicated(self, devices8):
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8), devices8)
+        tree = {"odd": jnp.ones((999, 77))}
+        sh = fsdp_sharding(mesh, tree, min_size=1)
+        assert sh["odd"].spec == P()
+
+    def test_batch_sharding_uses_present_axes(self, mesh_2d):
+        sh = batch_sharding(mesh_2d)
+        assert sh.spec == P(("data", "fsdp"))
+
+
+class TestPartitioners:
+    def test_fixed_shards(self):
+        p = FixedShardsPartitioner(4)
+        assert p((100, 16)) == [4, 1]
+        assert p((2, 16)) == [2, 1]
+
+    def test_min_size(self):
+        # 1M rows x 16 cols x 4B = 64MB; min shard 1MB, up to 8 shards.
+        p = MinSizePartitioner(min_shard_bytes=1 << 20, max_shards=8)
+        assert p((1 << 20, 16), np.float32) == [8, 1]
+        # Tiny variable: one shard.
+        assert p((16, 16), np.float32) == [1, 1]
+
+    def test_max_size(self):
+        # 64MB total, 16MB cap -> 4 shards.
+        p = MaxSizePartitioner(max_shard_bytes=16 << 20)
+        assert p((1 << 20, 16), np.float32) == [4, 1]

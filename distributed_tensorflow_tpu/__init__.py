@@ -43,7 +43,12 @@ def __getattr__(name):
     if name in _SUBMODULES:
         import importlib
 
-        module = importlib.import_module(f"distributed_tensorflow_tpu.{name}")
+        try:
+            module = importlib.import_module(f"distributed_tensorflow_tpu.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"submodule {name!r} is declared but not implemented yet"
+            ) from e
         globals()[name] = module
         return module
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

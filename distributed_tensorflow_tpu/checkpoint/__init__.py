@@ -1,0 +1,5 @@
+"""Checkpointing (SURVEY.md §4.5, §6.4): orbax-backed save/restore."""
+
+from distributed_tensorflow_tpu.checkpoint.manager import CheckpointManager
+
+__all__ = ["CheckpointManager"]

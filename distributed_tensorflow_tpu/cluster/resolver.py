@@ -147,9 +147,6 @@ class TPUClusterResolver(ClusterResolver):
     def cluster_spec(self) -> ClusterSpec:
         return ClusterSpec({})
 
-    def num_accelerators(self) -> int:
-        return len([d for d in jax.local_devices() if d.platform != "cpu"])
-
     @property
     def environment(self) -> str:
         return "tpu"

@@ -55,14 +55,22 @@ class MeshConfig:
 
     def axis_sizes(self, num_devices: int) -> Dict[str, int]:
         sizes = {a: getattr(self, a) for a in MESH_AXES}
+        bad = {a: s for a, s in sizes.items() if s != -1 and s < 1}
+        if bad:
+            raise ValueError(
+                f"Mesh axis sizes must be -1 (wildcard) or >= 1, got {bad}"
+            )
         fixed = math.prod(s for s in sizes.values() if s != -1)
         wild = [a for a, s in sizes.items() if s == -1]
         if len(wild) > 1:
             raise ValueError(f"At most one axis may be -1, got {wild}")
         if wild:
             if num_devices % fixed != 0:
+                fixed_sizes = {a: s for a, s in sizes.items() if s > 1}
                 raise ValueError(
-                    f"{num_devices} devices not divisible by fixed axes {sizes}"
+                    f"Cannot factor {num_devices} device(s): the fixed mesh "
+                    f"axes {fixed_sizes or '{}'} need a multiple of {fixed} "
+                    f"devices (axis {wild[0]!r} absorbs the remainder)"
                 )
             sizes[wild[0]] = num_devices // fixed
         elif fixed != num_devices:

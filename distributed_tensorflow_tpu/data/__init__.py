@@ -1,0 +1,23 @@
+"""Input pipeline: per-host sharding + device prefetch (SURVEY.md §3.4)."""
+
+from distributed_tensorflow_tpu.data.pipeline import (
+    Batch,
+    DevicePrefetchIterator,
+    make_global_batches,
+    per_host_batch_size,
+    shard_options,
+    synthetic_image_classification,
+    synthetic_lm,
+    synthetic_recsys,
+)
+
+__all__ = [
+    "Batch",
+    "DevicePrefetchIterator",
+    "make_global_batches",
+    "per_host_batch_size",
+    "shard_options",
+    "synthetic_image_classification",
+    "synthetic_lm",
+    "synthetic_recsys",
+]

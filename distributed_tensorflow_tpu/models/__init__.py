@@ -1,0 +1,65 @@
+"""Model families: the five reference workloads (SURVEY.md §3.5).
+
+Each model module exposes ``make_workload(**overrides) -> Workload``; the
+registry maps CLI names to factories.  A ``Workload`` bundles everything the
+unified ``train.py`` entrypoint needs: the flax module, the loss, a synthetic
+per-host data source (real data slots in by replacing ``data_fn``), sharding
+rules, and per-workload defaults (batch size, grad accum — e.g. GPT-2's
+gradient-accumulation config, BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+from distributed_tensorflow_tpu.parallel.sharding import ShardingRules
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class Workload:
+    name: str
+    module: Any  # flax linen module
+    loss_fn: Callable  # (params, batch, rng) -> (loss, aux_dict)
+    init_batch: Dict[str, Any]  # tiny batch for module.init / shape eval
+    data_fn: Callable[[int], Iterator[Dict[str, Any]]]  # per-host batch iter
+    rules: ShardingRules
+    batch_size: int  # default global batch size
+    grad_accum_steps: int = 1
+    clip_grad_norm: Optional[float] = None
+    learning_rate: float = 1e-3
+    warmup_steps: int = 100
+    # key in the batch dict whose leading dim counts "examples" for metrics
+    example_key: str = "image"
+    # Key of init_batch passed positionally to module.init; None passes the
+    # whole init_batch dict (for models that consume the batch directly).
+    init_key: Optional[str] = None
+
+
+_REGISTRY = {
+    "mnist": "distributed_tensorflow_tpu.models.mnist_cnn",
+    "resnet50": "distributed_tensorflow_tpu.models.resnet",
+    "bert": "distributed_tensorflow_tpu.models.bert",
+    "gpt2": "distributed_tensorflow_tpu.models.gpt2",
+    "wide_deep": "distributed_tensorflow_tpu.models.wide_deep",
+}
+
+
+def available_models() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_workload(name: str, **overrides) -> Workload:
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown model {name!r}; available: {available_models()}")
+    try:
+        mod = importlib.import_module(_REGISTRY[name])
+    except ModuleNotFoundError as e:
+        raise NotImplementedError(
+            f"Model family {name!r} is registered but its module "
+            f"{_REGISTRY[name]} is not implemented yet"
+        ) from e
+    return mod.make_workload(**overrides)

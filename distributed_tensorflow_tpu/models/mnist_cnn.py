@@ -1,0 +1,77 @@
+"""MNIST 2-layer CNN — reference workload 1 (BASELINE.json: "MNIST 2-layer
+CNN, single worker (CPU baseline for PR1)").
+
+The classic tutorial model the reference's single-worker train.py builds:
+two conv layers, two dense layers, softmax cross-entropy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.data.pipeline import synthetic_image_classification
+from distributed_tensorflow_tpu.models import Workload
+from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="logits")(x)
+        return x
+
+
+def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+    logits = module.apply({"params": params}, batch["image"])
+    labels = batch["label"]
+    loss = jnp.mean(
+        -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels]
+    )
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
+def make_workload(
+    *,
+    batch_size: int = 256,
+    num_classes: int = 10,
+    **_unused,
+) -> Workload:
+    module = MnistCNN(num_classes=num_classes)
+    return Workload(
+        name="mnist",
+        module=module,
+        loss_fn=functools.partial(_loss_fn, module),
+        init_batch={
+            "image": np.zeros((2, 28, 28, 1), np.float32),
+            "label": np.zeros((2,), np.int32),
+        },
+        data_fn=lambda per_host_bs: synthetic_image_classification(
+            batch_size=per_host_bs, image_size=(28, 28, 1),
+            num_classes=num_classes,
+        ),
+        rules=ShardingRules(),  # small model: fully replicated (pure DP)
+        batch_size=batch_size,
+        learning_rate=1e-3,
+        example_key="image",
+        init_key="image",
+    )

@@ -27,7 +27,14 @@ def __getattr__(name):
     if name in _LAZY:
         import importlib
 
-        module = importlib.import_module(f"distributed_tensorflow_tpu.parallel.{name}")
+        try:
+            module = importlib.import_module(
+                f"distributed_tensorflow_tpu.parallel.{name}"
+            )
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"parallel submodule {name!r} is declared but not implemented yet"
+            ) from e
         globals()[name] = module
         return module
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,259 @@
+"""Unified training entrypoint logic — the "train.py runs unchanged" contract.
+
+Behavioral model: the reference's per-model train.py scripts (SURVEY.md §3.5,
+§4.1–4.3): they accept ``TF_CONFIG`` or ``--job_name/--task_index``, build a
+distribution strategy, and loop.  Here one entrypoint serves all five
+workloads; the launcher contract is preserved exactly (ps tasks park in
+``server.join()``), and the distribution mechanics are TPU-native: mesh +
+NamedSharding + one compiled step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.data import (
+    DevicePrefetchIterator,
+    per_host_batch_size,
+)
+from distributed_tensorflow_tpu.models import Workload, available_models, get_workload
+from distributed_tensorflow_tpu.parallel.sharding import batch_sharding
+from distributed_tensorflow_tpu.training import (
+    BF16,
+    FP32,
+    CheckpointHook,
+    LoggingHook,
+    NanHook,
+    ProfilerHook,
+    TrainLoop,
+    TrainState,
+    make_train_step,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainArgs:
+    model: str = "mnist"
+    steps: int = 200
+    batch_size: Optional[int] = None  # global; default from workload
+    grad_accum_steps: Optional[int] = None
+    learning_rate: Optional[float] = None
+    precision: str = "bf16"
+    # mesh axes (data=-1 absorbs the rest)
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    context: int = 1
+    expert: int = 1
+    # launcher contract
+    job_name: Optional[str] = None
+    task_index: Optional[int] = None
+    # io
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1000
+    log_every: int = 50
+    profile_dir: Optional[str] = None
+    seed: int = 0
+
+
+def parse_args(argv=None) -> TrainArgs:
+    p = argparse.ArgumentParser(description="TPU-native distributed training")
+    p.add_argument("--model", choices=available_models(), default="mnist")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--grad_accum_steps", type=int, default=None)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--precision", choices=("bf16", "fp32"), default="bf16")
+    for axis in ("data", "fsdp", "tensor", "pipe", "context", "expert"):
+        p.add_argument(f"--{axis}", type=int,
+                       default=-1 if axis == "data" else 1,
+                       help=f"mesh size of the {axis!r} axis")
+    p.add_argument("--job_name", type=str, default=None,
+                   help="TF1 launcher contract: ps|worker|chief|evaluator")
+    p.add_argument("--task_index", type=int, default=None)
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--checkpoint_every", type=int, default=1000)
+    p.add_argument("--log_every", type=int, default=50)
+    p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    ns = p.parse_args(argv)
+    return TrainArgs(**vars(ns))
+
+
+def build_state_and_step(
+    workload: Workload,
+    mesh,
+    *,
+    precision=BF16,
+    grad_accum_steps: int = 1,
+    learning_rate: Optional[float] = None,
+    total_steps: int = 1000,
+    seed: int = 0,
+):
+    """Initialize a sharded TrainState + sharded compiled train step."""
+    lr = learning_rate if learning_rate is not None else workload.learning_rate
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=min(workload.warmup_steps, max(1, total_steps // 10)),
+        decay_steps=max(2, total_steps),
+    )
+    tx = optax.adamw(schedule, weight_decay=1e-4)
+
+    rng = jax.random.key(seed)
+
+    def init_fn():
+        init_input = (
+            workload.init_batch if workload.init_key is None
+            else workload.init_batch[workload.init_key]
+        )
+        params = workload.module.init(rng, init_input)["params"]
+        return TrainState.create(
+            apply_fn=workload.module.apply, params=params, tx=tx
+        )
+
+    abstract_state = jax.eval_shape(init_fn)
+    # One rule table shards params AND optimizer moments: regex paths match
+    # both "params/.../kernel" and "opt_state/.../mu/.../kernel".
+    state_shardings = workload.rules.shardings_for(mesh, abstract_state)
+    state = jax.jit(init_fn, out_shardings=state_shardings)()
+
+    raw_step = make_train_step(
+        workload.loss_fn,
+        grad_accum_steps=grad_accum_steps,
+        precision=precision,
+        clip_grad_norm=workload.clip_grad_norm,
+        jit=False,
+    )
+    bsh = batch_sharding(mesh)
+    batch_shardings = {k: bsh for k in workload.init_batch}
+    train_step = jax.jit(
+        raw_step,
+        in_shardings=(state_shardings, batch_shardings, NamedSharding(mesh, P())),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+    return state, state_shardings, train_step, batch_shardings
+
+
+def run(args: TrainArgs) -> Dict[str, Any]:
+    """Full entrypoint. Returns final host metrics (for tests/benchmarks)."""
+    # force=True: the TPU plugin may have configured root handlers already,
+    # which would silently swallow basicConfig and therefore all INFO logs.
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+        force=True,
+    )
+
+    # 1. Launcher contract: resolve cluster role.
+    resolver = cluster_lib.resolve(args.job_name, args.task_index)
+    server = cluster_lib.Server.from_resolver(resolver)
+    if not resolver.is_compute_task():
+        logger.info(
+            "task %s:%s is a %s task: parameters are mesh-sharded on TPU; "
+            "parking in join() for launcher compatibility",
+            resolver.task_type, resolver.task_id, resolver.task_type,
+        )
+        server.join()
+        return {}
+
+    # 2. Mesh over the global device set.
+    mesh = cluster_lib.build_mesh(
+        cluster_lib.MeshConfig(
+            data=args.data, fsdp=args.fsdp, tensor=args.tensor,
+            pipe=args.pipe, context=args.context, expert=args.expert,
+        )
+    )
+    logger.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
+
+    # 3. Workload.
+    overrides = {}
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    workload = get_workload(args.model, **overrides)
+    grad_accum = args.grad_accum_steps or workload.grad_accum_steps
+    precision = BF16 if args.precision == "bf16" else FP32
+
+    state, state_shardings, train_step, batch_shardings = build_state_and_step(
+        workload,
+        mesh,
+        precision=precision,
+        grad_accum_steps=grad_accum,
+        learning_rate=args.learning_rate,
+        total_steps=args.steps,
+        seed=args.seed,
+    )
+
+    # Cross-host consistency guard before the first collective (SURVEY §6.2).
+    cluster_lib.assert_same_program("train_state", jax.eval_shape(lambda s: s, state))
+
+    # 4. Input pipeline: per-host slice -> global sharded arrays -> prefetch.
+    host_bs = per_host_batch_size(workload.batch_size)
+    host_iter = workload.data_fn(host_bs)
+    bsh = batch_shardings[workload.example_key]
+    data_iter = DevicePrefetchIterator(host_iter, bsh, prefetch=2)
+
+    # 5. Hooks.
+    hooks = [LoggingHook(every_steps=args.log_every), NanHook()]
+    manager = None
+    if args.checkpoint_dir:
+        manager = CheckpointManager(
+            args.checkpoint_dir, max_to_keep=3,
+            save_interval_steps=args.checkpoint_every,
+        )
+        state = manager.restore_or_init(state)
+        hooks.append(CheckpointHook(manager, every_steps=args.checkpoint_every))
+    if args.profile_dir:
+        hooks.append(ProfilerHook(args.profile_dir))
+
+    # 6. Loop.
+    loop = TrainLoop(
+        train_step,
+        state,
+        data_iter,
+        hooks=hooks,
+        examples_per_step=workload.batch_size,
+        metrics_every=min(10, args.log_every),
+        rng=jax.random.key(args.seed + 1),
+    )
+    start_step = int(jax.device_get(state.step))
+    remaining = max(0, args.steps - start_step)
+    final_state = loop.run(remaining)
+
+    data_iter.close()
+    if manager is not None:
+        manager.close()
+    server.shutdown()
+
+    result = {
+        "final_step": int(jax.device_get(final_state.step)),
+        **loop.last_logged_metrics,
+    }
+    logger.info("done: %s", result)
+    return result
+
+
+def main(argv=None):
+    result = run(parse_args(argv))
+    if result:
+        print(result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Training loop, compiled step, state, metrics (SURVEY.md §2 L6, §4.1)."""
+
+from distributed_tensorflow_tpu.training.loop import (
+    CheckpointHook,
+    Hook,
+    LoggingHook,
+    NanHook,
+    ProfilerHook,
+    TrainLoop,
+)
+from distributed_tensorflow_tpu.training.metrics import RunningMean, ThroughputMeter
+from distributed_tensorflow_tpu.training.step import make_eval_step, make_train_step
+from distributed_tensorflow_tpu.training.train_state import (
+    BF16,
+    FP32,
+    Precision,
+    TrainState,
+)
+
+__all__ = [
+    "BF16",
+    "FP32",
+    "CheckpointHook",
+    "Hook",
+    "LoggingHook",
+    "NanHook",
+    "Precision",
+    "ProfilerHook",
+    "RunningMean",
+    "ThroughputMeter",
+    "TrainLoop",
+    "TrainState",
+    "make_eval_step",
+    "make_train_step",
+]

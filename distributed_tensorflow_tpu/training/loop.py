@@ -1,0 +1,178 @@
+"""Monitored training loop with hooks.
+
+Behavioral model: TF1's ``MonitoredTrainingSession`` + session-run hooks
+($TF/python/training/monitored_session.py:428;
+basic_session_run_hooks.py — ``LoggingTensorHook``:169, ``StepCounterHook``
+:674, ``CheckpointSaverHook``:524, ``NanTensorHook``:761 — SURVEY.md §6.5)
+and TF2 Keras ``Model.fit``'s callback loop.  The loop is deliberately thin:
+the heavy lifting happens inside the compiled step; hooks observe at step
+boundaries on the host.  Device→host transfers of metrics are throttled
+(``log_every``) so the loop never blocks the device pipeline every step —
+the TPU equivalent of keeping the feed queue full.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.training.metrics import RunningMean, ThroughputMeter
+from distributed_tensorflow_tpu.training.train_state import TrainState
+
+logger = logging.getLogger(__name__)
+PyTree = Any
+
+
+class Hook:
+    """Step-boundary observer (SessionRunHook equivalent)."""
+
+    def begin(self, loop: "TrainLoop") -> None:  # noqa: D401
+        pass
+
+    def after_step(self, loop: "TrainLoop", step: int,
+                   metrics: Optional[Dict[str, float]]) -> None:
+        pass
+
+    def end(self, loop: "TrainLoop", step: int) -> None:
+        pass
+
+
+class LoggingHook(Hook):
+    """LoggingTensorHook + StepCounterHook in one."""
+
+    def __init__(self, every_steps: int = 100):
+        self.every_steps = every_steps
+        self._mean = RunningMean()
+
+    def begin(self, loop):
+        self._meter = ThroughputMeter(loop.examples_per_step)
+
+    def after_step(self, loop, step, metrics):
+        self._meter.update()
+        if metrics is not None:
+            self._mean.update(metrics)
+        if step % self.every_steps == 0 and step > 0:
+            m = {**self._mean.report_and_reset(), **self._meter.report()}
+            msg = ", ".join(f"{k}={v:.4g}" for k, v in sorted(m.items()))
+            logger.info("step %d: %s", step, msg)
+            loop.last_logged_metrics = m
+
+
+class NanHook(Hook):
+    """Stop (or raise) on non-finite loss (NanTensorHook equivalent)."""
+
+    def __init__(self, fail_on_nan: bool = True):
+        self.fail_on_nan = fail_on_nan
+
+    def after_step(self, loop, step, metrics):
+        if metrics is None:
+            return
+        loss = metrics.get("loss")
+        if loss is not None and not math.isfinite(loss):
+            if self.fail_on_nan:
+                raise FloatingPointError(f"Non-finite loss at step {step}: {loss}")
+            logger.error("Non-finite loss at step %d; requesting stop", step)
+            loop.request_stop()
+
+
+class CheckpointHook(Hook):
+    """CheckpointSaverHook equivalent over the orbax manager."""
+
+    def __init__(self, manager, every_steps: int = 1000):
+        self.manager = manager
+        self.every_steps = every_steps
+
+    def after_step(self, loop, step, metrics):
+        if step > 0 and step % self.every_steps == 0:
+            self.manager.save(step, loop.state)
+
+    def end(self, loop, step):
+        self.manager.save(step, loop.state, force=True)
+        self.manager.wait_until_finished()
+
+
+class ProfilerHook(Hook):
+    """jax.profiler trace over a step window (tf.profiler equivalent,
+    SURVEY.md §6.1)."""
+
+    def __init__(self, log_dir: str, start_step: int = 10, num_steps: int = 5):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def after_step(self, loop, step, metrics):
+        if step == self.start_step and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif step >= self.stop_step and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, loop, step):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class TrainLoop:
+    """Drives (state, batch) -> state for a fixed number of steps.
+
+    Metrics are fetched to host only every ``metrics_every`` steps; other
+    steps stay fully async on device.
+    """
+
+    def __init__(
+        self,
+        train_step: Callable,
+        state: TrainState,
+        data_iter: Iterable[PyTree],
+        *,
+        hooks: Optional[List[Hook]] = None,
+        examples_per_step: int = 0,
+        metrics_every: int = 10,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.train_step = train_step
+        self.state = state
+        self.data_iter = iter(data_iter)
+        self.hooks = hooks or []
+        self.examples_per_step = examples_per_step
+        self.metrics_every = max(1, metrics_every)
+        self.rng = rng if rng is not None else jax.random.key(0)
+        self.last_logged_metrics: Dict[str, float] = {}
+        self._stop = False
+
+    def request_stop(self) -> None:
+        self._stop = True
+
+    def run(self, num_steps: int) -> TrainState:
+        for h in self.hooks:
+            h.begin(self)
+        start = int(jax.device_get(self.state.step))
+        completed = start  # last step the state actually reflects
+        try:
+            for step in range(start, start + num_steps):
+                if self._stop:
+                    break
+                batch = next(self.data_iter)
+                self.rng, step_rng = jax.random.split(self.rng)
+                self.state, metrics = self.train_step(self.state, batch, step_rng)
+                completed = step + 1
+                host_metrics = None
+                if completed % self.metrics_every == 0:
+                    host_metrics = {
+                        k: float(np.asarray(jax.device_get(v)))
+                        for k, v in metrics.items()
+                    }
+                for h in self.hooks:
+                    h.after_step(self, completed, host_metrics)
+        finally:
+            for h in self.hooks:
+                h.end(self, completed)
+        return self.state

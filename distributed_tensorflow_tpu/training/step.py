@@ -1,0 +1,131 @@
+"""The compiled training step: forward/backward/update as one XLA program.
+
+Behavioral model: the reference's per-step path (SURVEY.md §4.1): per-replica
+forward/backward, gradient allreduce via CollectiveAllReduce, optimizer
+apply.  TPU-native, the *entire* step — including the gradient mean across
+data-parallel shards and the optimizer update — is one jitted program; XLA
+inserts the AllReduce from the shardings (no explicit collective in the
+common path) and overlaps it with backward compute.
+
+Gradient accumulation (the reference's GPT-2-medium answer to memory,
+BASELINE.json config 5) is a ``lax.scan`` over microbatches — static shapes,
+one compilation, accumulators in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.training.train_state import Precision, BF16, TrainState
+
+PyTree = Any
+# loss_fn(params, batch, rng) -> (loss, aux_metrics)
+LossFn = Callable[[PyTree, PyTree, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    *,
+    grad_accum_steps: int = 1,
+    precision: Precision = BF16,
+    clip_grad_norm: Optional[float] = None,
+    donate: bool = True,
+    jit: bool = True,
+) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the (optionally jitted) train step.
+
+    With ``grad_accum_steps > 1`` the batch's leading dim must be
+    ``grad_accum_steps * microbatch``; it is reshaped and scanned.
+    Pass ``jit=False`` to get the raw step fn for re-jitting with explicit
+    shardings (``shard_train_step``) or for embedding in a larger program.
+    """
+
+    def compute_grads(params, batch, rng):
+        compute_params = precision.cast_for_compute(params)
+
+        def scalar_loss(p, b):
+            loss, aux = loss_fn(p, b, rng)
+            return loss.astype(jnp.float32), aux
+
+        (loss, aux), grads = jax.value_and_grad(scalar_loss, has_aux=True)(
+            compute_params, batch
+        )
+        # Master-dtype gradients for the f32 accumulator/optimizer.
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        return loss, aux, grads
+
+    def step(state: TrainState, batch: PyTree, rng: jax.Array):
+        if grad_accum_steps == 1:
+            loss, aux, grads = compute_grads(state.params, batch, rng)
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape((grad_accum_steps, -1) + x.shape[1:]), batch
+            )
+
+            def body(carry, mb):
+                acc, loss_acc = carry
+                mb_rng = jax.random.fold_in(rng, loss_acc[1].astype(jnp.int32))
+                loss, aux, grads = compute_grads(state.params, mb, mb_rng)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return (acc, (loss_acc[0] + loss, loss_acc[1] + 1)), aux
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (grads, (loss_sum, _)), aux = jax.lax.scan(
+                body, (zero, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
+                micro,
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum_steps, grads)
+            loss = loss_sum / grad_accum_steps
+            aux = jax.tree.map(lambda x: x.mean(axis=0), aux)
+
+        metrics = {"loss": loss, **aux}
+        if clip_grad_norm is not None:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            metrics["grad_norm"] = gnorm
+        new_state = state.apply_gradients(grads)
+        return new_state, metrics
+
+    if not jit:
+        return step
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(
+    loss_fn: LossFn, *, precision: Precision = BF16
+) -> Callable[[TrainState, PyTree, jax.Array], Dict[str, jax.Array]]:
+    def step(state: TrainState, batch: PyTree, rng: jax.Array):
+        loss, aux = loss_fn(precision.cast_for_compute(state.params), batch, rng)
+        return {"loss": loss.astype(jnp.float32), **aux}
+
+    return jax.jit(step)
+
+
+def shard_train_step(
+    train_step: Callable,
+    mesh: Mesh,
+    state_shardings: PyTree,
+    batch_sharding: NamedSharding,
+):
+    """Re-jit a train step with explicit in/out shardings.
+
+    This is where the MultiWorkerMirroredStrategy contract is enforced
+    TPU-natively: state shardings say where parameters live (replicated for
+    pure DP, partitioned for fsdp/tensor), the batch sharding splits input
+    over data axes, and XLA derives every collective from that.
+    """
+    return jax.jit(
+        train_step.__wrapped__ if hasattr(train_step, "__wrapped__") else train_step,
+        in_shardings=(state_shardings, batch_sharding, NamedSharding(mesh, P())),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,75 @@
+"""Train state: parameters + optimizer state as one sharded pytree.
+
+Behavioral model: the reference stack's distributed-variable containers
+(``MirroredVariable``/``SyncOnReadVariable``, $TF/python/distribute/values.py
+:1196,:1294 — SURVEY.md §3.4) and TF1's global-step/Saver state.  TPU-native,
+all of that collapses to a single immutable pytree whose leaves carry
+``NamedSharding``s: "mirrored" is a replicated sharding, "sharded variable"
+is a partitioned sharding, and the optimizer update is a pure function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter + params + optimizer state (flax-style, framework-owned)."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: optax.OptState
+    # Static (non-pytree) fields:
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: PyTree) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state
+        )
+
+    @classmethod
+    def create(cls, *, apply_fn: Callable, params: PyTree,
+               tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Mixed-precision policy: f32 master params, bf16 compute on the MXU.
+
+    The reference's GPU path uses fp32 (or apex-style fp16 w/ loss scaling);
+    on TPU bf16 needs no loss scaling — same exponent range as f32.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_for_compute(self, tree: PyTree) -> PyTree:
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+FP32 = Precision(compute_dtype=jnp.float32)
+BF16 = Precision()

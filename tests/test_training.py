@@ -1,0 +1,238 @@
+"""End-to-end training-slice tests: step, loop, checkpoint, train_lib.
+
+Mirrors SURVEY.md §5's tier (a)/(b): unit + simulated-mesh tests.  The
+acceptance bar for the slice is the reference's own: loss goes down on the
+MNIST workload, checkpoints resume exactly, hooks observe what they should.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.models import available_models, get_workload
+from distributed_tensorflow_tpu.train_lib import TrainArgs, build_state_and_step, run
+from distributed_tensorflow_tpu.training import (
+    BF16,
+    FP32,
+    LoggingHook,
+    NanHook,
+    TrainLoop,
+    TrainState,
+    make_train_step,
+)
+
+
+def quadratic_loss(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    loss = jnp.mean((pred - batch["y"]) ** 2)
+    return loss, {"mae": jnp.mean(jnp.abs(pred - batch["y"]))}
+
+
+def make_linear_state(lr=0.1):
+    params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros((1,))}
+    return TrainState.create(
+        apply_fn=lambda p, x: x @ p["w"] + p["b"],
+        params=params,
+        tx=optax.sgd(lr),
+    )
+
+
+def linear_batch(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w + 0.1
+    return {"x": x, "y": y}
+
+
+class TestTrainStep:
+    def test_linear_regression_converges(self):
+        state = make_linear_state()
+        step = make_train_step(quadratic_loss, precision=FP32)
+        batch = linear_batch()
+        rng = jax.random.key(0)
+        losses = []
+        for _ in range(100):
+            state, m = step(state, batch, rng)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < 0.02 * losses[0]
+
+    def test_grad_accum_matches_full_batch(self):
+        # SGD: mean-of-microbatch-grads == full-batch grad, so one accum step
+        # must equal one full-batch step exactly (up to fp assoc).
+        batch = linear_batch(64)
+        rng = jax.random.key(0)
+
+        s_full = make_linear_state()
+        step_full = make_train_step(quadratic_loss, precision=FP32)
+        s_full, m_full = step_full(s_full, batch, rng)
+
+        s_acc = make_linear_state()
+        step_acc = make_train_step(
+            quadratic_loss, grad_accum_steps=4, precision=FP32
+        )
+        s_acc, m_acc = step_acc(s_acc, batch, rng)
+
+        np.testing.assert_allclose(
+            np.asarray(s_full.params["w"]), np.asarray(s_acc.params["w"]),
+            rtol=1e-5,
+        )
+        assert int(s_acc.step) == 1
+
+    def test_clip_grad_norm(self):
+        state = make_linear_state(lr=1.0)
+        w_before = np.asarray(state.params["w"]).copy()  # state is donated
+        step = make_train_step(
+            quadratic_loss, precision=FP32, clip_grad_norm=1e-3
+        )
+        batch = linear_batch()
+        new_state, m = step(state, batch, jax.random.key(0))
+        delta = jnp.linalg.norm(np.asarray(new_state.params["w"]) - w_before)
+        assert float(delta) <= 1.1e-3
+        assert "grad_norm" in m
+
+    def test_bf16_policy_keeps_master_f32(self):
+        state = make_linear_state()
+        step = make_train_step(quadratic_loss, precision=BF16)
+        state, _ = step(state, linear_batch(), jax.random.key(0))
+        assert state.params["w"].dtype == jnp.float32
+
+
+class TestTrainLoop:
+    def test_loop_runs_hooks_and_counts_steps(self, caplog):
+        state = make_linear_state()
+        step = make_train_step(quadratic_loss, precision=FP32)
+        data = iter(lambda: linear_batch(), None)  # infinite same batch
+
+        loop = TrainLoop(
+            step, state, data,
+            hooks=[LoggingHook(every_steps=10), NanHook()],
+            examples_per_step=64, metrics_every=5,
+        )
+        with caplog.at_level(logging.INFO):
+            final = loop.run(20)
+        assert int(jax.device_get(final.step)) == 20
+        assert loop.last_logged_metrics.get("loss") is not None
+
+    def test_nan_hook_raises(self):
+        def bad_loss(params, batch, rng):
+            return jnp.float32(jnp.nan), {}
+
+        state = make_linear_state()
+        step = make_train_step(bad_loss, precision=FP32)
+        data = iter(lambda: linear_batch(), None)
+        loop = TrainLoop(step, state, data, hooks=[NanHook()],
+                         metrics_every=1)
+        with pytest.raises(FloatingPointError):
+            loop.run(3)
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = make_linear_state()
+        step = make_train_step(quadratic_loss, precision=FP32)
+        state, _ = step(state, linear_batch(), jax.random.key(0))
+
+        mngr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        assert mngr.save(1, state)
+        mngr.wait_until_finished()
+        assert mngr.latest_step() == 1
+
+        fresh = make_linear_state()
+        restored = mngr.restore(template=fresh)
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+        )
+        assert int(restored.step) == 1
+        mngr.close()
+
+    def test_restore_or_init_without_checkpoint(self, tmp_path):
+        mngr = CheckpointManager(str(tmp_path / "empty"), async_save=False)
+        state = make_linear_state()
+        out = mngr.restore_or_init(state)
+        assert out is state
+        mngr.close()
+
+    def test_max_to_keep(self, tmp_path):
+        mngr = CheckpointManager(
+            str(tmp_path / "gc"), max_to_keep=2, async_save=False
+        )
+        state = make_linear_state()
+        for s in (1, 2, 3):
+            mngr.save(s, state, force=True)
+        mngr.wait_until_finished()
+        assert list(mngr.all_steps()) == [2, 3]
+        mngr.close()
+
+
+class TestTrainLib:
+    def test_mnist_end_to_end_loss_decreases(self, tmp_path):
+        res = run(TrainArgs(
+            model="mnist", steps=150, batch_size=64, log_every=50,
+            learning_rate=3e-3, precision="fp32",
+        ))
+        assert res["final_step"] == 150
+        assert res["loss"] < 2.0  # clearly better than uniform 10-class CE
+
+    def test_mnist_sharded_over_mesh_axes(self):
+        # data x fsdp mesh exercise on the virtual 8-device mesh.
+        res = run(TrainArgs(
+            model="mnist", steps=20, batch_size=64, data=4, fsdp=2,
+            log_every=10, precision="fp32",
+        ))
+        assert res["final_step"] == 20
+
+    def test_checkpoint_resume_continues_at_step(self, tmp_path):
+        ckpt = str(tmp_path / "resume")
+        run(TrainArgs(model="mnist", steps=30, batch_size=64,
+                      checkpoint_dir=ckpt, checkpoint_every=10,
+                      log_every=10, precision="fp32"))
+        res = run(TrainArgs(model="mnist", steps=50, batch_size=64,
+                            checkpoint_dir=ckpt, checkpoint_every=10,
+                            log_every=10, precision="fp32"))
+        assert res["final_step"] == 50
+
+    def test_ps_task_parks_and_returns_nothing(self):
+        import threading
+
+        from distributed_tensorflow_tpu.cluster import server as server_mod
+
+        # Run ps-role entrypoint in a thread; it parks in join().  We can't
+        # easily shut it down through run()'s internals, so assert it is
+        # still parked after a moment, then release it via the Server object.
+        import json, os
+        env_backup = os.environ.get("TF_CONFIG")
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": ["localhost:1"], "ps": ["localhost:2"]},
+            "task": {"type": "ps", "index": 0},
+        })
+        try:
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(run(TrainArgs(model="mnist"))),
+                daemon=True,
+            )
+            t.start()
+            t.join(timeout=1.0)
+            assert t.is_alive()  # parked, as a TF ps would be
+        finally:
+            if env_backup is None:
+                del os.environ["TF_CONFIG"]
+            else:
+                os.environ["TF_CONFIG"] = env_backup
+
+
+class TestWorkloadRegistry:
+    def test_mnist_registered(self):
+        assert "mnist" in available_models()
+        w = get_workload("mnist", batch_size=32)
+        assert w.batch_size == 32
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError):
+            get_workload("alexnet")

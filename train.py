@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Unified train entrypoint — the reference's train.py launcher contract.
+
+Examples:
+    python train.py --model=mnist --steps=500
+    python train.py --model=resnet50 --steps=100 --batch_size=256
+    TF_CONFIG='{"cluster":{"worker":["h0:9999","h1:9999"]},"task":{"type":"worker","index":0}}' \
+        python train.py --model=resnet50
+    python train.py --model=bert --job_name=ps --task_index=0   # parks like a TF ps
+"""
+
+from distributed_tensorflow_tpu.train_lib import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Benchmark entrypoint (driver contract): prints ONE JSON line.
+
+Measures the north-star metric (BASELINE.json): ResNet-50 images/sec/chip on
+the local device (real TPU under axon; CPU elsewhere for smoke).  No published
+reference numbers exist (BASELINE.json "published": {} and the reference
+mount was empty — SURVEY.md §0/§7), so ``vs_baseline`` is reported against
+the first value this repo itself recorded in BASELINE.md's ladder; until one
+exists it is 1.0 by definition.
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.models import get_workload
+    from distributed_tensorflow_tpu.train_lib import build_state_and_step
+    from distributed_tensorflow_tpu.training import BF16
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    # Per-chip batch: the standard ResNet-50 per-accelerator size. On CPU
+    # (smoke mode) shrink everything so the line still prints quickly.
+    if on_tpu:
+        batch, image, stages, warmup, iters = 256, 224, (3, 4, 6, 3), 5, 20
+    else:
+        batch, image, stages, warmup, iters = 16, 64, (1, 1, 1, 1), 1, 3
+
+    from distributed_tensorflow_tpu.data import per_host_batch_size
+    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+
+    n_dev = jax.device_count()
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=n_dev))
+    wl = get_workload(
+        "resnet50",
+        batch_size=batch * n_dev,
+        image_size=image,
+        stage_sizes=stages,
+    )
+    state, state_sh, train_step, batch_sh = build_state_and_step(
+        wl, mesh, precision=BF16, total_steps=warmup + iters,
+    )
+    sh = batch_sh[wl.example_key]
+    it = make_global_batches(
+        wl.data_fn(per_host_batch_size(wl.batch_size)), sh
+    )
+
+    rng = jax.random.key(0)
+    b = next(it)
+    for i in range(warmup):
+        state, m = train_step(state, b, jax.random.fold_in(rng, i))
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, m = train_step(state, b, jax.random.fold_in(rng, warmup + i))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = wl.batch_size * iters / dt
+    per_chip = images_per_sec / n_dev
+
+    # Own-baseline ladder: first recorded real-TPU value is the 1.0 reference
+    # point.  CPU smoke runs use a different (tiny) config, so they neither
+    # read nor write the baseline and report under a distinct metric name.
+    baseline_file = os.path.join(os.path.dirname(__file__), ".bench_baseline.json")
+    vs_baseline = 1.0
+    if on_tpu:
+        try:
+            with open(baseline_file) as f:
+                recorded = json.load(f)
+            if recorded.get("unit") == "images/sec/chip" and recorded.get("value"):
+                vs_baseline = per_chip / float(recorded["value"])
+        except (OSError, ValueError):
+            try:
+                with open(baseline_file, "w") as f:
+                    json.dump(
+                        {"value": per_chip, "unit": "images/sec/chip"}, f
+                    )
+            except OSError:
+                pass
+
+    print(json.dumps({
+        "metric": (
+            "resnet50_images_per_sec_per_chip" if on_tpu
+            else "resnet_tiny_cpu_smoke_images_per_sec"
+        ),
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

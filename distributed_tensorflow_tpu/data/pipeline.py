@@ -163,6 +163,50 @@ def synthetic_lm(
         yield {"tokens": tokens.astype(np.int32)}
 
 
+def synthetic_mlm(
+    *,
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    mask_token: int = 1,
+    mask_rate: float = 0.15,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """BERT-pretraining-style stream: masked tokens + segment ids + NSP label.
+
+    Tokens have the same local structure as ``synthetic_lm`` so MLM is
+    learnable; the NSP label marks whether the second segment continues the
+    first sequence or is an independent draw.
+    """
+    num_shards, index = shard_options()
+    rng = np.random.RandomState(seed * 3001 + index)
+    half = seq_len // 2
+    while True:
+        start = rng.randint(2, vocab_size, size=(batch_size, 1))
+        steps = rng.randint(1, 7, size=(batch_size, seq_len))
+        tokens = (start + np.cumsum(steps, axis=1)) % vocab_size
+        tokens = np.maximum(tokens, 2)  # 0=pad, 1=mask reserved
+        # NSP: for half the examples, replace the second segment with an
+        # unrelated sequence.
+        nsp = rng.randint(0, 2, size=(batch_size,))
+        rand_seg = rng.randint(2, vocab_size, size=(batch_size, seq_len - half))
+        second = np.where(nsp[:, None] == 1, tokens[:, half:], rand_seg)
+        tokens = np.concatenate([tokens[:, :half], second], axis=1)
+        segment_ids = np.concatenate(
+            [np.zeros((batch_size, half)), np.ones((batch_size, seq_len - half))],
+            axis=1,
+        )
+        mlm_mask = (rng.rand(batch_size, seq_len) < mask_rate)
+        masked = np.where(mlm_mask, mask_token, tokens)
+        yield {
+            "tokens": masked.astype(np.int32),
+            "mlm_targets": tokens.astype(np.int32),
+            "mlm_mask": mlm_mask.astype(np.float32),
+            "segment_ids": segment_ids.astype(np.int32),
+            "nsp_label": nsp.astype(np.int32),
+        }
+
+
 def synthetic_recsys(
     *,
     batch_size: int,

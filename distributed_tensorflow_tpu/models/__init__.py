@@ -37,6 +37,19 @@ class Workload:
     # Key of init_batch passed positionally to module.init; None passes the
     # whole init_batch dict (for models that consume the batch directly).
     init_key: Optional[str] = None
+    # True if the model carries mutable collections (e.g. BatchNorm
+    # batch_stats); switches loss_fn to the StatefulLossFn signature.
+    stateful: bool = False
+    # Inference-mode loss for evaluation.  For stateful models this must use
+    # the running statistics (e.g. BatchNorm use_running_average=True) —
+    # reusing the training loss_fn would normalize with per-batch stats.
+    # Signature matches loss_fn's (stateful or not); stateful eval fns
+    # return (loss, aux, model_state_unchanged).  None: reuse loss_fn
+    # (correct only for stateless models whose loss is deterministic-safe).
+    eval_loss_fn: Optional[Callable] = None
+    # Optional optimizer factory: schedule -> optax.GradientTransformation.
+    # None uses the framework default (adamw).
+    make_optimizer: Optional[Callable[[Any], Any]] = None
 
 
 _REGISTRY = {

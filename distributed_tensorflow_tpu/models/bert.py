@@ -1,0 +1,210 @@
+"""BERT-base pretraining — reference workload 3 (BASELINE.json: "BERT-base
+pretraining — between-graph replication (TF1-style PS/worker)").
+
+Distribution semantics: the reference ran this between-graph over a
+PS/worker cluster (SURVEY.md §4.2) — every parameter transit crossed gRPC
+RecvTensor.  TPU-native there is no PS: parameters are mesh-sharded (fsdp)
+or replicated, and the launcher contract (`--job_name=ps` tasks park in
+``server.join()``) is honored by ``train_lib`` so the reference's launch
+scripts work unchanged.
+
+Model notes:
+
+- Post-LN encoder (original BERT), gelu, learned position + segment
+  embeddings.
+- Fused qkv projection ("qkv") for one big MXU matmul; names are chosen to
+  hit ``transformer_rules``'s TP patterns (qkv/out_proj/fc1/fc2).
+- Pretraining heads: MLM (tied to word embeddings) + NSP on [CLS];
+  loss = masked CE + NSP CE, the standard pretraining objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.data.pipeline import synthetic_mlm
+from distributed_tensorflow_tpu.models import Workload
+from distributed_tensorflow_tpu.parallel.sharding import (
+    P,
+    ShardingRules,
+    transformer_rules,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_positions: int = 512
+    type_vocab: int = 2
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(vocab_size=256, max_positions=64, d_model=64, n_layer=2,
+                   n_head=4, d_ff=128, dropout=0.0, **kw)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool):
+        cfg = self.cfg
+        d, h = cfg.d_model, cfg.n_head
+        head_dim = d // h
+        B, T, _ = x.shape
+
+        qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, h, head_dim)
+        k = k.reshape(B, T, h, head_dim)
+        v = v.reshape(B, T, h, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(cfg.dtype)
+        probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
+        attn = nn.Dense(d, dtype=cfg.dtype, name="out_proj")(ctx)
+        attn = nn.Dropout(cfg.dropout, deterministic=deterministic)(attn)
+        # Post-LN (original BERT)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+
+        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="fc1")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=cfg.dtype, name="fc2")(y)
+        y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
+
+
+class BertPretrain(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, jax.Array], *, deterministic: bool = True):
+        cfg = self.cfg
+        tokens = batch["tokens"]
+        segment_ids = batch.get(
+            "segment_ids", jnp.zeros_like(tokens)
+        )
+        B, T = tokens.shape
+        word = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=jnp.float32,
+                        name="word_embeddings")
+        pos = self.param("position_embeddings",
+                         nn.initializers.normal(0.02),
+                         (cfg.max_positions, cfg.d_model), jnp.float32)
+        seg = nn.Embed(cfg.type_vocab, cfg.d_model, dtype=jnp.float32,
+                       name="segment_embeddings")
+        x = word(tokens) + pos[:T] + seg(segment_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
+        x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+        x = x.astype(cfg.dtype)
+        for i in range(cfg.n_layer):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+
+        # MLM head: transform + tied decoder.
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm")(x)
+        y = nn.gelu(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(y)
+        mlm_logits = jnp.einsum(
+            "btd,vd->btv",
+            y.astype(jnp.float32),
+            word.embedding.astype(jnp.float32),
+        ) + self.param("mlm_bias", nn.initializers.zeros,
+                       (cfg.vocab_size,), jnp.float32)
+
+        # NSP head on position 0 ([CLS]).
+        pooled = jnp.tanh(
+            nn.Dense(cfg.d_model, dtype=jnp.float32, name="pooler")(
+                x[:, 0].astype(jnp.float32)
+            )
+        )
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp")(pooled)
+        return mlm_logits, nsp_logits
+
+
+def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+    mlm_logits, nsp_logits = module.apply(
+        {"params": params}, batch, deterministic=False, rngs={"dropout": rng},
+    )
+    mask = batch["mlm_mask"]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        mlm_logits, batch["mlm_targets"]
+    )
+    mlm_loss = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    nsp_loss = jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, batch["nsp_label"]
+        )
+    )
+    mlm_acc = jnp.sum(
+        (jnp.argmax(mlm_logits, -1) == batch["mlm_targets"]) * mask
+    ) / jnp.maximum(jnp.sum(mask), 1.0)
+    nsp_acc = jnp.mean(
+        (jnp.argmax(nsp_logits, -1) == batch["nsp_label"]).astype(jnp.float32)
+    )
+    return mlm_loss + nsp_loss, {
+        "mlm_loss": mlm_loss,
+        "nsp_loss": nsp_loss,
+        "mlm_accuracy": mlm_acc,
+        "nsp_accuracy": nsp_acc,
+    }
+
+
+def bert_rules() -> ShardingRules:
+    return transformer_rules().extended(
+        [
+            (r"word_embeddings/embedding", P("tensor", "fsdp")),
+            (r"(segment_embeddings/embedding|position_embeddings)", P()),
+        ]
+    )
+
+
+def make_workload(
+    *,
+    batch_size: int = 256,
+    seq_len: int = 128,
+    config: Optional[BertConfig] = None,
+    **_unused,
+) -> Workload:
+    cfg = config or BertConfig.base()
+    seq = min(seq_len, cfg.max_positions)
+    module = BertPretrain(cfg)
+    init_batch = {
+        "tokens": np.zeros((2, seq), np.int32),
+        "mlm_targets": np.zeros((2, seq), np.int32),
+        "mlm_mask": np.zeros((2, seq), np.float32),
+        "segment_ids": np.zeros((2, seq), np.int32),
+        "nsp_label": np.zeros((2,), np.int32),
+    }
+    return Workload(
+        name="bert",
+        module=module,
+        loss_fn=functools.partial(_loss_fn, module),
+        init_batch=init_batch,
+        data_fn=lambda per_host_bs: synthetic_mlm(
+            batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
+        ),
+        rules=bert_rules(),
+        batch_size=batch_size,
+        clip_grad_norm=1.0,
+        learning_rate=1e-4,
+        warmup_steps=1000,
+        example_key="tokens",
+        init_key=None,  # module consumes the whole batch dict
+    )

@@ -1,0 +1,190 @@
+"""GPT-2 — reference workload 5 (BASELINE.json: "GPT-2 medium — large
+allreduce + gradient accumulation").
+
+TPU-first design notes:
+
+- One fused qkv projection (``c_attn``) and one fused MLP — big matmuls for
+  the MXU, bf16 compute.
+- Megatron-style tensor parallelism comes entirely from sharding rules
+  (``transformer_rules``): column-parallel qkv/fc-in, row-parallel
+  out-proj/fc-out.  No collective appears in model code; XLA derives the
+  all-reduces from the shardings.
+- Gradient accumulation is the reference's answer to GPT-2-medium memory
+  (``grad_accum_steps=4`` default here), implemented as ``lax.scan`` in the
+  compiled step — not a Python loop.
+- Weight-tied LM head (logits = x @ wte.T), standard GPT-2.
+- Attention is exact softmax attention via einsum; the long-context path
+  (ring attention over the ``context`` axis) lives in
+  ``parallel.ring_attention`` and activates when seq_len crosses
+  ``ring_attention_threshold`` and the mesh has a context axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.data.pipeline import synthetic_lm
+from distributed_tensorflow_tpu.models import Workload
+from distributed_tensorflow_tpu.parallel.sharding import (
+    P,
+    ShardingRules,
+    transformer_rules,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    d_model: int = 1024
+    n_layer: int = 24
+    n_head: int = 16
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def small(cls, **kw):
+        return cls(d_model=768, n_layer=12, n_head=12, **kw)
+
+    @classmethod
+    def medium(cls, **kw):  # 355M — the reference's config
+        return cls(d_model=1024, n_layer=24, n_head=16, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):  # tests
+        return cls(vocab_size=256, n_positions=128, d_model=64, n_layer=2,
+                   n_head=4, dropout=0.0, **kw)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool):
+        cfg = self.cfg
+        d, h = cfg.d_model, cfg.n_head
+        head_dim = d // h
+        B, T, _ = x.shape
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="c_attn")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, h, head_dim)
+        k = k.reshape(B, T, h, head_dim)
+        v = v.reshape(B, T, h, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(cfg.dtype)
+        probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
+        attn_out = nn.Dense(d, dtype=cfg.dtype, name="c_proj")(ctx)
+        attn_out = nn.Dropout(cfg.dropout, deterministic=deterministic)(attn_out)
+        x = x + attn_out
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        mlp = nn.Dense(4 * d, dtype=cfg.dtype, name="mlp_c_fc")(y)
+        mlp = nn.gelu(mlp, approximate=True)
+        mlp = nn.Dense(d, dtype=cfg.dtype, name="mlp_c_proj")(mlp)
+        mlp = nn.Dropout(cfg.dropout, deterministic=deterministic)(mlp)
+        return x + mlp
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.cfg
+        B, T = tokens.shape
+        wte = self.param(
+            "wte",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        wpe = self.param(
+            "wpe",
+            nn.initializers.normal(0.01),
+            (cfg.n_positions, cfg.d_model),
+            jnp.float32,
+        )
+        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+        for i in range(cfg.n_layer):
+            x = Block(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Weight-tied head; logits in f32 for a stable softmax.
+        logits = jnp.einsum(
+            "btd,vd->btv", x.astype(jnp.float32), wte.astype(jnp.float32)
+        )
+        return logits
+
+
+def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+    tokens = batch["tokens"]
+    logits = module.apply(
+        {"params": params},
+        tokens,
+        deterministic=False,
+        rngs={"dropout": rng},
+    )
+    # next-token prediction: shift left
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    loss = jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    )
+    return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def gpt2_rules() -> ShardingRules:
+    """TP/fsdp rules for this module's parameter names."""
+    return transformer_rules().extended(
+        [
+            (r"wte$", P("tensor", "fsdp")),
+            (r"wpe$", P()),
+            (r"mlp_c_fc/kernel", P("fsdp", "tensor")),
+            (r"mlp_c_proj/kernel", P("tensor", "fsdp")),
+        ]
+    )
+
+
+def make_workload(
+    *,
+    preset: str = "medium",
+    batch_size: int = 32,
+    seq_len: Optional[int] = None,
+    grad_accum_steps: int = 4,
+    config: Optional[GPT2Config] = None,
+    **_unused,
+) -> Workload:
+    cfg = config or getattr(GPT2Config, preset)()
+    seq = seq_len or min(cfg.n_positions, 1024)
+    module = GPT2(cfg)
+    return Workload(
+        name="gpt2",
+        module=module,
+        loss_fn=functools.partial(_loss_fn, module),
+        init_batch={"tokens": np.zeros((2, seq), np.int32)},
+        data_fn=lambda per_host_bs: synthetic_lm(
+            batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
+        ),
+        rules=gpt2_rules(),
+        batch_size=batch_size,
+        grad_accum_steps=grad_accum_steps,
+        clip_grad_norm=1.0,
+        learning_rate=3e-4,
+        warmup_steps=200,
+        example_key="tokens",
+        init_key="tokens",
+    )

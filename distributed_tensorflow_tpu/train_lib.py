@@ -112,7 +112,10 @@ def build_state_and_step(
         warmup_steps=min(workload.warmup_steps, max(1, total_steps // 10)),
         decay_steps=max(2, total_steps),
     )
-    tx = optax.adamw(schedule, weight_decay=1e-4)
+    if workload.make_optimizer is not None:
+        tx = workload.make_optimizer(schedule)
+    else:
+        tx = optax.adamw(schedule, weight_decay=1e-4)
 
     rng = jax.random.key(seed)
 
@@ -121,9 +124,11 @@ def build_state_and_step(
             workload.init_batch if workload.init_key is None
             else workload.init_batch[workload.init_key]
         )
-        params = workload.module.init(rng, init_input)["params"]
+        variables = dict(workload.module.init(rng, init_input))
+        params = variables.pop("params")
         return TrainState.create(
-            apply_fn=workload.module.apply, params=params, tx=tx
+            apply_fn=workload.module.apply, params=params, tx=tx,
+            model_state=variables,
         )
 
     abstract_state = jax.eval_shape(init_fn)
@@ -138,6 +143,7 @@ def build_state_and_step(
         precision=precision,
         clip_grad_norm=workload.clip_grad_norm,
         jit=False,
+        stateful=workload.stateful,
     )
     bsh = batch_sharding(mesh)
     batch_shardings = {k: bsh for k in workload.init_batch}

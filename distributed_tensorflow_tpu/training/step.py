@@ -26,6 +26,12 @@ from distributed_tensorflow_tpu.training.train_state import Precision, BF16, Tra
 PyTree = Any
 # loss_fn(params, batch, rng) -> (loss, aux_metrics)
 LossFn = Callable[[PyTree, PyTree, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+# stateful variant (models with mutable collections, e.g. BatchNorm):
+# loss_fn(params, model_state, batch, rng) -> (loss, aux, new_model_state)
+StatefulLossFn = Callable[
+    [PyTree, PyTree, PyTree, jax.Array],
+    Tuple[jax.Array, Dict[str, jax.Array], PyTree],
+]
 
 
 def make_train_step(
@@ -36,6 +42,7 @@ def make_train_step(
     clip_grad_norm: Optional[float] = None,
     donate: bool = True,
     jit: bool = True,
+    stateful: bool = False,
 ) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the (optionally jitted) train step.
 
@@ -43,42 +50,51 @@ def make_train_step(
     ``grad_accum_steps * microbatch``; it is reshaped and scanned.
     Pass ``jit=False`` to get the raw step fn for re-jitting with explicit
     shardings (``shard_train_step``) or for embedding in a larger program.
+    ``stateful=True`` switches to the ``StatefulLossFn`` signature and
+    threads ``state.model_state`` (e.g. batch_stats) through the step.
     """
 
-    def compute_grads(params, batch, rng):
+    def compute_grads(params, model_state, batch, rng):
         compute_params = precision.cast_for_compute(params)
 
         def scalar_loss(p, b):
+            if stateful:
+                loss, aux, new_ms = loss_fn(p, model_state, b, rng)
+                return loss.astype(jnp.float32), (aux, new_ms)
             loss, aux = loss_fn(p, b, rng)
-            return loss.astype(jnp.float32), aux
+            return loss.astype(jnp.float32), (aux, model_state)
 
-        (loss, aux), grads = jax.value_and_grad(scalar_loss, has_aux=True)(
+        (loss, (aux, new_ms)), grads = jax.value_and_grad(scalar_loss, has_aux=True)(
             compute_params, batch
         )
         # Master-dtype gradients for the f32 accumulator/optimizer.
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-        return loss, aux, grads
+        return loss, aux, grads, new_ms
 
     def step(state: TrainState, batch: PyTree, rng: jax.Array):
         if grad_accum_steps == 1:
-            loss, aux, grads = compute_grads(state.params, batch, rng)
+            loss, aux, grads, new_ms = compute_grads(
+                state.params, state.model_state, batch, rng
+            )
         else:
             micro = jax.tree.map(
                 lambda x: x.reshape((grad_accum_steps, -1) + x.shape[1:]), batch
             )
 
             def body(carry, mb):
-                acc, loss_acc = carry
+                acc, loss_acc, ms = carry
                 mb_rng = jax.random.fold_in(rng, loss_acc[1].astype(jnp.int32))
-                loss, aux, grads = compute_grads(state.params, mb, mb_rng)
+                loss, aux, grads, new_ms = compute_grads(state.params, ms, mb, mb_rng)
                 acc = jax.tree.map(jnp.add, acc, grads)
-                return (acc, (loss_acc[0] + loss, loss_acc[1] + 1)), aux
+                return (acc, (loss_acc[0] + loss, loss_acc[1] + 1), new_ms), aux
 
             zero = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
-            (grads, (loss_sum, _)), aux = jax.lax.scan(
-                body, (zero, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
+            (grads, (loss_sum, _), new_ms), aux = jax.lax.scan(
+                body,
+                (zero, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                 state.model_state),
                 micro,
             )
             grads = jax.tree.map(lambda g: g / grad_accum_steps, grads)
@@ -91,7 +107,7 @@ def make_train_step(
             scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
             grads = jax.tree.map(lambda g: g * scale, grads)
             metrics["grad_norm"] = gnorm
-        new_state = state.apply_gradients(grads)
+        new_state = state.apply_gradients(grads, new_model_state=new_ms)
         return new_state, metrics
 
     if not jit:
@@ -101,10 +117,14 @@ def make_train_step(
 
 
 def make_eval_step(
-    loss_fn: LossFn, *, precision: Precision = BF16
+    loss_fn: LossFn, *, precision: Precision = BF16, stateful: bool = False
 ) -> Callable[[TrainState, PyTree, jax.Array], Dict[str, jax.Array]]:
     def step(state: TrainState, batch: PyTree, rng: jax.Array):
-        loss, aux = loss_fn(precision.cast_for_compute(state.params), batch, rng)
+        params = precision.cast_for_compute(state.params)
+        if stateful:
+            loss, aux, _ = loss_fn(params, state.model_state, batch, rng)
+        else:
+            loss, aux = loss_fn(params, batch, rng)
         return {"loss": loss.astype(jnp.float32), **aux}
 
     return jax.jit(step)

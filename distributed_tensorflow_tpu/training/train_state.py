@@ -22,7 +22,13 @@ PyTree = Any
 
 
 class TrainState(struct.PyTreeNode):
-    """Step counter + params + optimizer state (flax-style, framework-owned)."""
+    """Step counter + params + optimizer state (flax-style, framework-owned).
+
+    ``model_state`` holds non-trainable variable collections (e.g. flax
+    ``batch_stats`` for BatchNorm).  Under global-batch jit the batch-stat
+    reduction spans the whole data-parallel batch, i.e. sync BatchNorm — the
+    semantics MultiWorkerMirroredStrategy only approximates per-replica.
+    """
 
     step: jax.Array
     params: PyTree
@@ -30,23 +36,34 @@ class TrainState(struct.PyTreeNode):
     # Static (non-pytree) fields:
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # Pytree field (mutable collections, e.g. batch_stats):
+    model_state: PyTree = struct.field(default_factory=dict)
 
-    def apply_gradients(self, grads: PyTree) -> "TrainState":
+    def apply_gradients(
+        self, grads: PyTree, new_model_state: Optional[PyTree] = None
+    ) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
-            step=self.step + 1, params=new_params, opt_state=new_opt_state
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            model_state=(
+                self.model_state if new_model_state is None else new_model_state
+            ),
         )
 
     @classmethod
     def create(cls, *, apply_fn: Callable, params: PyTree,
-               tx: optax.GradientTransformation) -> "TrainState":
+               tx: optax.GradientTransformation,
+               model_state: Optional[PyTree] = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             apply_fn=apply_fn,
             tx=tx,
+            model_state={} if model_state is None else model_state,
         )
 
 

@@ -73,17 +73,19 @@ def main():
     baseline_file = os.path.join(os.path.dirname(__file__), ".bench_baseline.json")
     vs_baseline = 1.0
     if on_tpu:
-        try:
+        if os.path.exists(baseline_file):
+            # Never overwrite an existing anchor — a corrupt file is a hard
+            # error, not a license to re-baseline.
             with open(baseline_file) as f:
                 recorded = json.load(f)
             if recorded.get("unit") == "images/sec/chip" and recorded.get("value"):
                 vs_baseline = per_chip / float(recorded["value"])
-        except (OSError, ValueError):
+        else:
+            tmp = baseline_file + ".tmp"
             try:
-                with open(baseline_file, "w") as f:
-                    json.dump(
-                        {"value": per_chip, "unit": "images/sec/chip"}, f
-                    )
+                with open(tmp, "w") as f:
+                    json.dump({"value": per_chip, "unit": "images/sec/chip"}, f)
+                os.replace(tmp, baseline_file)
             except OSError:
                 pass
 

@@ -1,0 +1,23 @@
+"""Fault tolerance: preemption handling, health checking, auto-resume.
+
+Behavioral model (SURVEY.md §6.3): TF's ``PreemptionCheckpointHandler``
+($TF/python/distribute/failure_handling/failure_handling.py:337) with
+platform ``TerminationConfig``s, ``PreemptionWatcher``
+(preemption_watcher.py:45), MWMS's ``_enable_check_health`` thread
+(collective_all_reduce_strategy.py:340), and the ClusterCoordinator's
+``WorkerPreemptionHandler`` (cluster_coordinator.py:841).
+"""
+
+from distributed_tensorflow_tpu.ft.preemption import (
+    PreemptionCheckpointHook,
+    PreemptionWatcher,
+    TerminationConfig,
+)
+from distributed_tensorflow_tpu.ft.health import HealthChecker
+
+__all__ = [
+    "HealthChecker",
+    "PreemptionCheckpointHook",
+    "PreemptionWatcher",
+    "TerminationConfig",
+]

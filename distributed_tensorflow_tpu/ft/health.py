@@ -1,0 +1,115 @@
+"""Peer health checking.
+
+Behavioral model: MultiWorkerMirroredStrategy's ``_enable_check_health``
+thread ($TF/python/distribute/collective_all_reduce_strategy.py:340 —
+SURVEY.md §6.3): a background thread probes peers every 30 s; on repeated
+failure it aborts collectives so the worker fails fast instead of hanging in
+an allreduce whose peer died.
+
+TPU-native: intra-slice peer death surfaces as an ICI/XLA error already; the
+gap is *host-level* liveness between controller processes.  The probe here is
+pluggable — default is a coordination barrier with timeout when
+``jax.distributed`` is live, no-op single-process — and the failure action is
+a callback (default: log + raise in the caller thread via a stored error).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def default_probe(timeout_s: float) -> bool:
+    """True if the cluster looks healthy.
+
+    Multi-process: run a named barrier; all live hosts enter it within the
+    timeout (mirrors TF's CheckHealth RPC semantics at the controller level).
+    Single-process: trivially healthy.
+    """
+    if jax.process_count() <= 1:
+        return True
+    try:
+        client = jax._src.distributed.global_state.client
+        if client is None:
+            return True
+        client.wait_at_barrier(
+            f"dtt_health_{int(time.time())}", timeout_in_ms=int(timeout_s * 1000)
+        )
+        return True
+    except Exception as e:  # barrier timeout / peer gone
+        logger.error("health probe failed: %s", e)
+        return False
+
+
+class HealthChecker:
+    """Background peer-liveness thread (check-health equivalent).
+
+    ``on_failure`` runs after ``failures_before_action`` consecutive failed
+    probes; default records the error for ``raise_if_unhealthy()`` — call it
+    at step boundaries to fail fast instead of hanging in a collective.
+    """
+
+    def __init__(
+        self,
+        *,
+        interval_s: float = 30.0,
+        timeout_s: float = 20.0,
+        failures_before_action: int = 2,
+        probe: Optional[Callable[[float], bool]] = None,
+        on_failure: Optional[Callable[[], None]] = None,
+    ):
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.failures_before_action = failures_before_action
+        self._probe = probe or default_probe
+        self._on_failure = on_failure
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._consecutive_failures = 0
+        self.error: Optional[Exception] = None
+
+    def start(self) -> "HealthChecker":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="dtt-health-check", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + 1)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            healthy = False
+            try:
+                healthy = self._probe(self.timeout_s)
+            except Exception as e:
+                logger.error("health probe raised: %s", e)
+            if healthy:
+                self._consecutive_failures = 0
+                continue
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.failures_before_action:
+                self.error = RuntimeError(
+                    f"cluster unhealthy: {self._consecutive_failures} "
+                    "consecutive failed health probes"
+                )
+                logger.error("%s", self.error)
+                if self._on_failure is not None:
+                    self._on_failure()
+                return
+
+    def raise_if_unhealthy(self) -> None:
+        if self.error is not None:
+            raise self.error

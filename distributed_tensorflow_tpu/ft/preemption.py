@@ -1,0 +1,158 @@
+"""Preemption-aware checkpointing: catch the signal, save, exit cleanly.
+
+Behavioral model: ``PreemptionCheckpointHandler``
+($TF/python/distribute/failure_handling/failure_handling.py:337 — SURVEY.md
+§6.3): a platform ``TerminationConfig`` names the preemption signal; when it
+fires, every worker agrees on a stopping step, a cluster-wide checkpoint is
+written, and the job exits so the scheduler can restart it; on restart,
+``CheckpointManager.restore_or_init`` resumes.
+
+TPU-native translation: the signal watcher is host-side (signals are a host
+concept either way); the cluster-wide agreement is a max-reduce of the local
+flag over hosts (``process_allgather``), replacing TF's coordination-service
+error propagation; the checkpoint is orbax (async off the critical path,
+forced synchronous on the preemption path).  When running under
+``jax.distributed``, JAX's own preemption sync manager
+(jax/_src/distributed.py:199) can be layered in by the cluster resolver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import threading
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.training.loop import Hook
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TerminationConfig:
+    """Which host signals mean "you are being preempted", and how long the
+    platform gives us (TF analog: failure_handling's per-platform
+    TerminationConfigs, e.g. GcePreemptionConfig/BorgTPUTerminationConfig).
+    """
+
+    signals: Sequence[int] = (signal.SIGTERM,)
+    grace_period_s: float = 30.0
+
+    @classmethod
+    def from_env(cls) -> "TerminationConfig":
+        """Generic platform detection via env (no cloud metadata here):
+        DTT_PREEMPTION_SIGNALS="SIGTERM,SIGUSR1" DTT_GRACE_PERIOD_S=30."""
+        names = os.environ.get("DTT_PREEMPTION_SIGNALS", "SIGTERM")
+        sigs = tuple(
+            getattr(signal, n.strip()) for n in names.split(",") if n.strip()
+        )
+        grace = float(os.environ.get("DTT_GRACE_PERIOD_S", "30"))
+        return cls(signals=sigs, grace_period_s=grace)
+
+
+class PreemptionWatcher:
+    """Host-side signal watcher (PreemptionWatcher equivalent).
+
+    ``preempted`` flips when any configured signal arrives.  Chains any
+    previously-installed handler so we don't break other users of SIGTERM.
+    """
+
+    def __init__(self, config: Optional[TerminationConfig] = None,
+                 on_preemption: Optional[Callable[[], None]] = None):
+        self._config = config or TerminationConfig.from_env()
+        self._event = threading.Event()
+        self._on_preemption = on_preemption
+        self._prev_handlers = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionWatcher":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError("signal handlers must be installed from the "
+                               "main thread")
+        for sig in self._config.signals:
+            self._prev_handlers[sig] = signal.getsignal(sig)
+            signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
+        self._prev_handlers.clear()
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        logger.warning("preemption signal %s received; will checkpoint and "
+                       "stop at the next sync point", signum)
+        self._event.set()
+        if self._on_preemption is not None:
+            self._on_preemption()
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def signal_preemption(self) -> None:
+        """Programmatic trigger (tests; external watchers)."""
+        self._event.set()
+
+
+def _any_host_preempted(local: bool) -> bool:
+    """Cluster OR-reduce of the local preemption flag."""
+    if jax.process_count() <= 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if local else 0], np.int32)
+    )
+    return bool(np.asarray(flags).max() > 0)
+
+
+class PreemptionCheckpointHook(Hook):
+    """TrainLoop hook: on preemption, force a checkpoint and stop the loop.
+
+    The cross-host agreement runs every ``sync_every`` steps (a host
+    allgather, off the device critical path); within one sync window all
+    hosts observe the same flag and stop at the same step — the
+    "coordinated checkpoint-then-exit" contract of TF's handler.
+    """
+
+    def __init__(self, manager, watcher: Optional[PreemptionWatcher] = None,
+                 *, sync_every: int = 10,
+                 exit_fn: Optional[Callable[[], None]] = None):
+        self.manager = manager
+        self._owns_watcher = watcher is None
+        self.watcher = watcher or PreemptionWatcher().install()
+        self.sync_every = max(1, sync_every)
+        self.exit_fn = exit_fn
+        self.handled = False
+
+    def end(self, loop, step):
+        if self._owns_watcher:
+            self.watcher.uninstall()
+
+    def after_step(self, loop, step, metrics):
+        if self.handled or step % self.sync_every != 0:
+            return
+        if _any_host_preempted(self.watcher.preempted):
+            self.handled = True
+            logger.warning(
+                "cluster-wide preemption detected at step %d: saving "
+                "checkpoint and stopping", step,
+            )
+            self.manager.save(step, loop.state, force=True)
+            self.manager.wait_until_finished()
+            loop.request_stop()
+            if self.exit_fn is not None:
+                self.exit_fn()

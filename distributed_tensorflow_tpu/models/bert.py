@@ -138,9 +138,13 @@ class BertPretrain(nn.Module):
         return mlm_logits, nsp_logits
 
 
-def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+def _loss_fn(module: nn.Module, deterministic: bool, params,
+             batch: Dict[str, jax.Array], rng):
     mlm_logits, nsp_logits = module.apply(
-        {"params": params}, batch, deterministic=False, rngs={"dropout": rng},
+        {"params": params},
+        batch,
+        deterministic=deterministic,
+        rngs=None if deterministic else {"dropout": rng},
     )
     mask = batch["mlm_mask"]
     per_tok = optax.softmax_cross_entropy_with_integer_labels(
@@ -195,7 +199,8 @@ def make_workload(
     return Workload(
         name="bert",
         module=module,
-        loss_fn=functools.partial(_loss_fn, module),
+        loss_fn=functools.partial(_loss_fn, module, False),
+        eval_loss_fn=functools.partial(_loss_fn, module, True),
         init_batch=init_batch,
         data_fn=lambda per_host_bs: synthetic_mlm(
             batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,

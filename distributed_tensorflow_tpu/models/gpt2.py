@@ -14,9 +14,8 @@ TPU-first design notes:
   compiled step — not a Python loop.
 - Weight-tied LM head (logits = x @ wte.T), standard GPT-2.
 - Attention is exact softmax attention via einsum; the long-context path
-  (ring attention over the ``context`` axis) lives in
-  ``parallel.ring_attention`` and activates when seq_len crosses
-  ``ring_attention_threshold`` and the mesh has a context axis.
+  (ring attention over the ``context`` axis, ``parallel.ring_attention``)
+  activates whenever the mesh's ``context`` axis has size > 1.
 """
 
 from __future__ import annotations
@@ -30,8 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import linen as nn
+from jax.sharding import Mesh
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_lm
+from distributed_tensorflow_tpu.parallel.ring_attention import ring_attention
 from distributed_tensorflow_tpu.models import Workload
 from distributed_tensorflow_tpu.parallel.sharding import (
     P,
@@ -66,6 +67,7 @@ class GPT2Config:
 
 class Block(nn.Module):
     cfg: GPT2Config
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool):
@@ -80,13 +82,22 @@ class Block(nn.Module):
         q = q.reshape(B, T, h, head_dim)
         k = k.reshape(B, T, h, head_dim)
         v = v.reshape(B, T, h, head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        probs = probs.astype(cfg.dtype)
-        probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
+        if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
+            # Long-context path: sequence sharded over the context axis, KV
+            # rotating over the ICI ring (parallel.ring_attention).  Exact
+            # attention; attention-prob dropout is unavailable here (the
+            # full prob matrix never materializes), residual dropout remains.
+            ctx = ring_attention(
+                q, k, v, mesh=self.mesh, causal=True
+            ).reshape(B, T, d)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            probs = probs.astype(cfg.dtype)
+            probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
         attn_out = nn.Dense(d, dtype=cfg.dtype, name="c_proj")(ctx)
         attn_out = nn.Dropout(cfg.dropout, deterministic=deterministic)(attn_out)
         x = x + attn_out
@@ -101,6 +112,7 @@ class Block(nn.Module):
 
 class GPT2(nn.Module):
     cfg: GPT2Config
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, tokens, *, deterministic: bool = True):
@@ -121,7 +133,9 @@ class GPT2(nn.Module):
         x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         for i in range(cfg.n_layer):
-            x = Block(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+            x = Block(cfg, mesh=self.mesh, name=f"h_{i}")(
+                x, deterministic=deterministic
+            )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Weight-tied head; logits in f32 for a stable softmax.
         logits = jnp.einsum(
@@ -130,13 +144,14 @@ class GPT2(nn.Module):
         return logits
 
 
-def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+def _loss_fn(module: nn.Module, deterministic: bool, params,
+             batch: Dict[str, jax.Array], rng):
     tokens = batch["tokens"]
     logits = module.apply(
         {"params": params},
         tokens,
-        deterministic=False,
-        rngs={"dropout": rng},
+        deterministic=deterministic,
+        rngs=None if deterministic else {"dropout": rng},
     )
     # next-token prediction: shift left
     targets = tokens[:, 1:]
@@ -166,15 +181,17 @@ def make_workload(
     seq_len: Optional[int] = None,
     grad_accum_steps: int = 4,
     config: Optional[GPT2Config] = None,
+    mesh: Optional[Mesh] = None,
     **_unused,
 ) -> Workload:
     cfg = config or getattr(GPT2Config, preset)()
     seq = seq_len or min(cfg.n_positions, 1024)
-    module = GPT2(cfg)
+    module = GPT2(cfg, mesh=mesh)
     return Workload(
         name="gpt2",
         module=module,
-        loss_fn=functools.partial(_loss_fn, module),
+        loss_fn=functools.partial(_loss_fn, module, False),
+        eval_loss_fn=functools.partial(_loss_fn, module, True),
         init_batch={"tokens": np.zeros((2, seq), np.int32)},
         data_fn=lambda per_host_bs: synthetic_lm(
             batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,

@@ -1,0 +1,179 @@
+"""Wide&Deep / DLRM — reference workload 4 (BASELINE.json: "Wide&Deep / DLRM
+— parameter-server embedding sharding").
+
+The reference ran this on ParameterServerStrategy: embedding tables sharded
+across ps tasks via ShardedVariable partitioners, every lookup a RecvTensor
+round-trip (SURVEY.md §4.3).  TPU-native, the tables are row-sharded across
+the mesh with ``parallel.embedding.ShardedEmbed`` (all-gather ids →
+local gather → psum_scatter exchange over ICI), optimizer state sharded
+identically — PS *semantics* (huge tables that live nowhere in full) without
+a PS runtime.
+
+Two architectures, one workload family:
+
+- ``arch="wide_deep"``: wide = linear model over sparse features (a (V, 1)
+  scalar table) + dense linear; deep = embeddings + dense → MLP.  Sum of
+  both logits (the classic Google Wide&Deep head).
+- ``arch="dlrm"``: bottom MLP on dense features → one D-dim vector; pairwise
+  dot-product interactions among [bottom, emb_1..emb_F]; top MLP on
+  [bottom, interactions].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from distributed_tensorflow_tpu.data.pipeline import synthetic_recsys
+from distributed_tensorflow_tpu.models import Workload
+from distributed_tensorflow_tpu.parallel.embedding import ShardedEmbed
+from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"fc{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class WideDeep(nn.Module):
+    vocab_size: int
+    emb_dim: int = 64
+    deep_layers: Sequence[int] = (1024, 512, 256, 1)
+    mesh: Optional[Mesh] = None
+    shard_axis: str = "data"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, jax.Array]):
+        dense, sparse = batch["dense"], batch["sparse"]
+        # Deep tower
+        emb = ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
+                           axis=self.shard_axis, name="deep_embed")(sparse)
+        B, F, D = emb.shape
+        deep_in = jnp.concatenate(
+            [emb.reshape(B, F * D).astype(self.dtype),
+             dense.astype(self.dtype)], axis=-1,
+        )
+        deep_logit = MLP(self.deep_layers, self.dtype, name="deep")(deep_in)
+        # Wide tower: linear over sparse (scalar table) + dense linear
+        wide_emb = ShardedEmbed(self.vocab_size, 1, mesh=self.mesh,
+                                axis=self.shard_axis, name="wide_embed")(sparse)
+        wide_logit = (
+            wide_emb.sum(axis=(1, 2), dtype=jnp.float32)[:, None]
+            + nn.Dense(1, dtype=jnp.float32, name="wide_dense")(dense)
+        )
+        return (deep_logit.astype(jnp.float32) + wide_logit).squeeze(-1)
+
+
+class DLRM(nn.Module):
+    vocab_size: int
+    emb_dim: int = 64
+    bottom_layers: Sequence[int] = (512, 256, 64)
+    top_layers: Sequence[int] = (512, 256, 1)
+    mesh: Optional[Mesh] = None
+    shard_axis: str = "data"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, jax.Array]):
+        dense, sparse = batch["dense"], batch["sparse"]
+        assert self.bottom_layers[-1] == self.emb_dim, (
+            "DLRM bottom MLP must end at emb_dim for dot interactions"
+        )
+        bottom = MLP(self.bottom_layers, self.dtype, name="bottom")(
+            dense.astype(self.dtype)
+        )  # (B, D)
+        emb = ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
+                           axis=self.shard_axis, name="deep_embed")(sparse)
+        vectors = jnp.concatenate(
+            [bottom[:, None, :], emb.astype(self.dtype)], axis=1
+        )  # (B, 1+F, D)
+        # Pairwise dot interactions (upper triangle, no diagonal) — one
+        # batched matmul on the MXU.
+        inter = jnp.einsum("bnd,bmd->bnm", vectors, vectors)
+        n = vectors.shape[1]
+        iu = jnp.triu_indices(n, k=1)
+        inter = inter[:, iu[0], iu[1]]  # (B, n*(n-1)/2)
+        top_in = jnp.concatenate([bottom, inter], axis=-1)
+        logit = MLP(self.top_layers, self.dtype, name="top")(top_in)
+        return logit.astype(jnp.float32).squeeze(-1)
+
+
+def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
+    logits = module.apply({"params": params}, batch)
+    labels = batch["label"]
+    loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+    acc = jnp.mean(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+    return loss, {"accuracy": acc}
+
+
+def recsys_rules(shard_axis: str = "data") -> ShardingRules:
+    """Tables row-sharded (PS-replacement); MLPs replicated (they're small)."""
+    return ShardingRules(
+        [
+            (r"(deep_embed|wide_embed)/embedding", P(shard_axis)),
+        ]
+    )
+
+
+def make_workload(
+    *,
+    arch: str = "wide_deep",
+    batch_size: int = 4096,
+    vocab_size: int = 100_000,
+    emb_dim: int = 64,
+    num_dense: int = 13,
+    num_sparse: int = 26,
+    mesh: Optional[Mesh] = None,
+    shard_axis: str = "data",
+    **_unused,
+) -> Workload:
+    if arch == "wide_deep":
+        module = WideDeep(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
+                          shard_axis=shard_axis)
+    elif arch == "dlrm":
+        module = DLRM(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
+                      shard_axis=shard_axis,
+                      bottom_layers=(512, 256, emb_dim))
+    else:
+        raise ValueError(f"unknown arch {arch!r}")
+    # Init batch must divide evenly over the shard axis (the lookup is a
+    # shard_map program with static per-shard shapes).
+    b0 = mesh.shape.get(shard_axis, 1) if mesh is not None else 2
+    b0 = max(b0, 2)
+    init_batch = {
+        "dense": np.zeros((b0, num_dense), np.float32),
+        "sparse": np.zeros((b0, num_sparse), np.int32),
+        "label": np.zeros((b0,), np.float32),
+    }
+    return Workload(
+        name="wide_deep",
+        module=module,
+        loss_fn=functools.partial(_loss_fn, module),
+        init_batch=init_batch,
+        data_fn=lambda per_host_bs: synthetic_recsys(
+            batch_size=per_host_bs, num_dense=num_dense,
+            num_sparse=num_sparse, vocab_size=vocab_size,
+        ),
+        rules=recsys_rules(shard_axis),
+        batch_size=batch_size,
+        learning_rate=1e-3,
+        warmup_steps=100,
+        example_key="dense",
+        init_key=None,
+    )

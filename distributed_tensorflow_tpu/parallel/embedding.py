@@ -1,0 +1,138 @@
+"""Sharded embedding tables — the TPU-native parameter-server replacement.
+
+Behavioral model: the reference's embedding sharding is PS-based —
+``ShardedVariable`` + partitioners round-robin table shards across ps tasks
+($TF/python/distribute/sharded_variable.py:843,:84,:115,:176), lookups cross
+worker↔ps as gRPC RecvTensor traffic (SURVEY.md §4.2, §4.4).  The in-stack
+TPU model is ``TPUEmbedding`` ($TF/python/tpu/tpu_embedding_v2.py:76): tables
+sharded across chips, lookups as device-side gather + cross-chip exchange,
+optimizer on-device.
+
+TPU-native design here:
+
+- The table lives **row-sharded over a mesh axis** (vocab dim): shard k holds
+  rows ``[k*V/N, (k+1)*V/N)``.  The optimizer state shards identically (the
+  sharding rule covers both, so "optimizer on-device per shard" is automatic).
+- Lookup is an explicit ``shard_map`` program:
+    1. ``all_gather`` the (small, int32) ids over the axis,
+    2. each shard gathers the rows it owns, zero elsewhere,
+    3. ``psum_scatter`` delivers summed rows back to the id's home shard —
+       the cross-chip exchange (TPUEmbedding's "exchange" step; the
+       reference's RecvTensor hop, now an ICI DMA).
+  Exactly one shard owns each id, so the sum reconstructs the row exactly.
+- Backward differentiates the same program: XLA transposes ``psum_scatter``
+  to ``all_gather`` and the gather to a scatter-add into the local shard —
+  the sparse-gradient path with **no dense (V, D) gradient materialized**.
+- Explicit shard_map (not GSPMD gather partitioning) because the whole point
+  is a *guarantee*: the table is never all-gathered, whatever its size.
+
+Cited reference files are TF-stack behavioral models, not copied code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.sharding import Partitioner
+
+
+def pad_vocab(vocab_size: int, num_shards: int) -> int:
+    """Round vocab up so shards are equal (XLA needs static equal shapes)."""
+    return int(-(-vocab_size // num_shards) * num_shards)
+
+
+def sharded_lookup(
+    table: jax.Array,
+    ids: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+    batch_axes: Optional[Sequence[str]] = None,
+) -> jax.Array:
+    """Gather ``table[ids]`` with the table row-sharded over ``axis``.
+
+    table: (V, D) with V % mesh.shape[axis] == 0 (see ``pad_vocab``).
+    ids:   integer array whose leading dim is the (sharded) batch.
+    Returns ids.shape + (D,), batch-sharded like ``ids``.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        return jnp.take(table, ids, axis=0)
+    vocab, dim = table.shape
+    if vocab % n:
+        raise ValueError(f"vocab {vocab} not divisible by {axis}={n}; "
+                         "pad with pad_vocab()")
+    rows_per_shard = vocab // n
+    batch_axes = tuple(batch_axes) if batch_axes is not None else (axis,)
+
+    def _local(table_shard, ids_shard):
+        # (1) ids everywhere (ints are tiny next to rows)
+        ids_all = jax.lax.all_gather(ids_shard, axis, axis=0, tiled=True)
+        # (2) local gather of owned rows
+        offset = jax.lax.axis_index(axis) * rows_per_shard
+        local = ids_all - offset
+        own = (local >= 0) & (local < rows_per_shard)
+        rows = jnp.take(
+            table_shard, jnp.clip(local, 0, rows_per_shard - 1), axis=0
+        )
+        rows = jnp.where(own[..., None], rows, jnp.zeros((), rows.dtype))
+        # (3) exchange: deliver each id's row to its home batch shard
+        return jax.lax.psum_scatter(rows, axis, scatter_dimension=0, tiled=True)
+
+    return jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(axis), P(batch_axes)),
+        out_specs=P(batch_axes),
+        check_vma=False,
+    )(table, ids)
+
+
+class ShardedEmbed(nn.Module):
+    """Row-sharded embedding layer (drop-in for ``nn.Embed`` at scale).
+
+    ``mesh=None`` (single-device tests / CPU) degrades to a plain gather.
+    The matching sharding rule for the parameter is ``P(axis)`` on dim 0 —
+    ``make_rule()`` returns it for ``ShardingRules`` composition.
+    """
+
+    num_embeddings: int
+    features: int
+    mesh: Optional[Mesh] = None
+    axis: str = "data"
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        n = self.mesh.shape.get(self.axis, 1) if self.mesh is not None else 1
+        self.padded_vocab = pad_vocab(self.num_embeddings, n)
+        self.embedding = self.param(
+            "embedding",
+            nn.initializers.normal(1.0 / np.sqrt(self.features)),
+            (self.padded_vocab, self.features),
+            self.param_dtype,
+        )
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        if self.mesh is None or self.mesh.shape.get(self.axis, 1) == 1:
+            return jnp.take(self.embedding, ids, axis=0)
+        return sharded_lookup(
+            self.embedding, ids, mesh=self.mesh, axis=self.axis
+        )
+
+    def make_rule(self) -> tuple:
+        return (r"embedding$", P(self.axis))
+
+
+def partitioned_shape(
+    partitioner: Partitioner, shape: Sequence[int], dtype=jnp.float32
+) -> Sequence[int]:
+    """TF-partitioner compatibility: shards-per-dim for a variable shape
+    (ShardedVariable semantics) — used to translate legacy PS configs into a
+    mesh axis size."""
+    return partitioner(list(shape), dtype)

@@ -1,0 +1,113 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the ``pipe``
+mesh axis.
+
+The reference stack has NO pipeline parallelism (SURVEY.md §3.1: "ABSENT —
+net-new in the build"); its answer to model size was gradient accumulation.
+This module adds PP the TPU way: the whole schedule is ONE compiled XLA
+program —
+
+- stage parameters live stacked along a leading stage dim, sharded over
+  ``pipe`` (each chip holds exactly its stage's slice);
+- a ``lax.scan`` over ticks runs the fill/steady/drain schedule; stage
+  hand-off is ``lax.ppermute`` (HLO CollectivePermute — neighbor DMA on the
+  ICI torus, the role the gRPC RecvTensor rendezvous played between PS/worker
+  graph partitions, SURVEY.md §4.2);
+- every stage computes every tick (SPMD), with masking for bubble ticks;
+  backward is autodiff through the scan (GPipe fill-drain, activations
+  stashed per tick by the scan transpose).
+
+With M microbatches over S stages the bubble fraction is (S-1)/(M+S-1) —
+choose M >= 4*S for >80% utilization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+# stage_fn(stage_params, x) -> y ; same x/y shape for all stages
+StageFn = Callable[[PyTree, jax.Array], jax.Array]
+
+
+def stack_stage_params(per_stage_params: list) -> PyTree:
+    """Stack a list of per-stage param pytrees along a new leading dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stage_sharding(mesh: Mesh, stacked: PyTree, axis: str = "pipe") -> PyTree:
+    """NamedShardings placing dim 0 (the stage dim) on the pipe axis."""
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P(axis)), stacked
+    )
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked_params: PyTree,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pipe",
+) -> jax.Array:
+    """Run ``x`` through S pipelined stages.
+
+    stacked_params: leaves of shape (S, ...), sharded over ``axis``.
+    x: (M, microbatch, ...) — M microbatches (global, replicated or
+       batch-sharded on the microbatch dim over data axes).
+    Returns (M, microbatch, ...) = stage_{S-1}(...stage_0(x)), replicated
+    over ``axis``.
+    """
+    S = mesh.shape[axis]
+    if S == 1:
+        params0 = jax.tree.map(lambda p: p[0], stacked_params)
+        return jax.vmap(lambda mb: stage_fn(params0, mb))(x)
+    M = x.shape[0]
+
+    def _local(params, x_loc):
+        # params leaves: (1, ...) — this chip's stage; x_loc: (M, mb...)
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        idx = lax.axis_index(axis)
+        T = M + S - 1  # fill + steady + drain ticks
+        mb_zero = jnp.zeros_like(x_loc[0])
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            # stage 0 feeds microbatch t (clipped during drain); others take
+            # what arrived from the left neighbor last tick.
+            x_t = lax.dynamic_index_in_dim(
+                x_loc, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            inp = jnp.where(idx == 0, x_t, recv)
+            out = stage_fn(params, inp)
+            # last stage owns finished microbatch j = t - (S-1)
+            j = t - (S - 1)
+            take = (idx == S - 1) & (j >= 0)
+            upd = lax.dynamic_update_index_in_dim(
+                outbuf, out, jnp.clip(j, 0, M - 1), 0
+            )
+            outbuf = jnp.where(take, upd, outbuf)
+            # hand off to the right neighbor (ring edge S-1 -> 0 is ignored:
+            # stage 0 always reads x_t)
+            recv_next = lax.ppermute(out, axis, perm)
+            return (recv_next, outbuf), None
+
+        outbuf0 = jnp.zeros((M,) + x_loc.shape[1:], x_loc.dtype)
+        (_, outbuf), _ = lax.scan(tick, (mb_zero, outbuf0), jnp.arange(T))
+        # deliver result from the last stage to every stage (psum of a
+        # one-hot-masked buffer) so the output is replicated over the axis.
+        outbuf = jnp.where(idx == S - 1, outbuf, jnp.zeros_like(outbuf))
+        return lax.psum(outbuf, axis)
+
+    return jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x)

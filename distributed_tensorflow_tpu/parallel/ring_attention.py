@@ -1,0 +1,146 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+The long-context / sequence-parallel subsystem.  The reference stack has
+nothing here (SURVEY.md §6.7 — its answer to big models was gradient
+accumulation), so this is net-new capability, built the TPU way:
+
+- The sequence axis is sharded over the ``context`` mesh axis; each chip
+  holds Q/K/V blocks of length T/N.
+- K/V blocks rotate around the ICI ring via ``lax.ppermute`` (HLO
+  CollectivePermute — a neighbor DMA, the cheapest collective on a torus)
+  while each chip accumulates its queries' attention over every block —
+  compute and transfer overlap across ring steps.
+- Numerics: blockwise *online softmax* (running max + running denominator,
+  flash-attention style) in f32, so the result is exact attention, not an
+  approximation, for any number of ring steps.
+- Causal masking is positional: block owner index × block length gives each
+  key's global position; masking happens inside the block computation.
+
+The per-block computation is a plain einsum (XLA fuses it well); swap in
+``ops.flash_attention`` for the fused-VMEM Pallas version where profitable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale):
+    """One (q-block × kv-block) partial attention with positional masking.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D).  Returns (scores-weighted values,
+    running max, running denom) pieces in f32:
+      partial: (B, Tq, H, D), m: (B, H, Tq), l: (B, H, Tq)
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(Tq)
+        k_pos = k_offset + jnp.arange(Tk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)  # (B, H, Tq)
+    # All-masked rows (early q positions vs late kv blocks): exp(-inf - -inf)
+    # is nan; pin m to 0 there so p == 0 and nothing accumulates.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])  # (B, H, Tq, Tk)
+    l = jnp.sum(p, axis=-1)  # (B, H, Tq)
+    partial = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return partial.astype(jnp.float32), m_safe, l
+
+
+def _combine(acc, l_acc, m_acc, partial, l_new, m_new):
+    """Merge a new block into the online-softmax accumulator.
+
+    acc: (B, Tq, H, D); l/m: (B, H, Tq).
+    """
+    m_next = jnp.maximum(m_acc, m_new)
+    alpha = jnp.exp(m_acc - m_next)  # rescale old
+    beta = jnp.exp(m_new - m_next)  # rescale new
+    acc = (acc * jnp.moveaxis(alpha, 1, 2)[..., None]
+           + partial * jnp.moveaxis(beta, 1, 2)[..., None])
+    l_next = l_acc * alpha + l_new * beta
+    return acc, l_next, m_next
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "context",
+    causal: bool = True,
+    batch_axes: tuple = ("data", "fsdp"),
+) -> jax.Array:
+    """Exact attention with the sequence dim sharded over ``axis``.
+
+    q, k, v: (B, T, H, D) global arrays, T sharded over ``axis``.
+    Returns (B, T, H, D), sharded like q.
+    """
+    n = mesh.shape.get(axis, 1)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if n == 1:
+        return _dense_attention(q, k, v, causal=causal, scale=scale)
+
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    spec = P(batch, axis)
+
+    def _local(q_blk, k_blk, v_blk):
+        B, Tq, H, D = q_blk.shape
+        my = lax.axis_index(axis)
+        q_off = my * Tq
+
+        def step(carry, i):
+            acc, l_acc, m_acc, k_cur, v_cur = carry
+            # kv block currently held arrived from neighbor `my + i` (ring
+            # shifts move blocks to lower indices each step).
+            owner = (my + i) % n
+            partial, m_new, l_new = _block_attend(
+                q_blk, k_cur, v_cur,
+                q_offset=q_off, k_offset=owner * Tq,
+                causal=causal, scale=scale,
+            )
+            acc, l_acc, m_acc = _combine(acc, l_acc, m_acc,
+                                         partial, l_new, m_new)
+            # rotate kv around the ring (neighbor DMA on ICI)
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            return (acc, l_acc, m_acc, k_nxt, v_nxt), None
+
+        init = (
+            jnp.zeros((B, Tq, H, D), jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.full((B, H, Tq), -jnp.inf, jnp.float32),
+        )
+        # pin -inf init max to finite for the first combine
+        init = (init[0], init[1], jnp.full((B, H, Tq), -1e30, jnp.float32),
+                k_blk, v_blk)
+        (acc, l_acc, _, _, _), _ = lax.scan(step, init, jnp.arange(n))
+        out = acc / jnp.maximum(jnp.moveaxis(l_acc, 1, 2), 1e-30)[..., None]
+        return out.astype(q_blk.dtype)
+
+    return jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _dense_attention(q, k, v, *, causal, scale):
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

@@ -47,6 +47,7 @@ logger = logging.getLogger(__name__)
 @dataclasses.dataclass
 class TrainArgs:
     model: str = "mnist"
+    arch: Optional[str] = None  # sub-architecture (wide_deep | dlrm)
     steps: int = 200
     batch_size: Optional[int] = None  # global; default from workload
     grad_accum_steps: Optional[int] = None
@@ -73,6 +74,8 @@ class TrainArgs:
 def parse_args(argv=None) -> TrainArgs:
     p = argparse.ArgumentParser(description="TPU-native distributed training")
     p.add_argument("--model", choices=available_models(), default="mnist")
+    p.add_argument("--arch", type=str, default=None,
+                   help="sub-architecture for recsys models: wide_deep|dlrm")
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--grad_accum_steps", type=int, default=None)
@@ -187,10 +190,18 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     )
     logger.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
-    # 3. Workload.
-    overrides = {}
+    # 3. Workload.  The mesh is passed so mesh-aware models (sharded
+    # embeddings) can bind their exchange axis; factories ignore it otherwise.
+    overrides = {"mesh": mesh}
     if args.batch_size:
         overrides["batch_size"] = args.batch_size
+    if args.arch:
+        if args.model != "wide_deep":
+            raise ValueError(
+                f"--arch only applies to --model=wide_deep, got "
+                f"--model={args.model} --arch={args.arch}"
+            )
+        overrides["arch"] = args.arch
     workload = get_workload(args.model, **overrides)
     grad_accum = args.grad_accum_steps or workload.grad_accum_steps
     precision = BF16 if args.precision == "bf16" else FP32
@@ -224,6 +235,11 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         )
         state = manager.restore_or_init(state)
         hooks.append(CheckpointHook(manager, every_steps=args.checkpoint_every))
+        # Fault tolerance (SURVEY §6.3): preemption signal → coordinated
+        # checkpoint + stop; restart resumes via restore_or_init above.
+        from distributed_tensorflow_tpu.ft import PreemptionCheckpointHook
+
+        hooks.append(PreemptionCheckpointHook(manager))
     if args.profile_dir:
         hooks.append(ProfilerHook(args.profile_dir))
 

@@ -1,0 +1,163 @@
+"""Fault-tolerance tests — SURVEY.md §5's fault-injection tier:
+(a) in-process: signal → coordinated checkpoint → stop → resume;
+(b) subprocess: kill a real training run mid-flight, restart, assert resume.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.ft import (
+    HealthChecker,
+    PreemptionCheckpointHook,
+    PreemptionWatcher,
+    TerminationConfig,
+)
+from distributed_tensorflow_tpu.training import FP32, TrainLoop, make_train_step
+from distributed_tensorflow_tpu.training.loop import Hook
+from tests.test_training import linear_batch, make_linear_state, quadratic_loss
+
+
+class TestPreemptionWatcher:
+    def test_real_signal_sets_flag(self):
+        w = PreemptionWatcher(TerminationConfig(signals=(signal.SIGUSR1,)))
+        w.install()
+        try:
+            assert not w.preempted
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0.05)
+            assert w.preempted
+        finally:
+            w.uninstall()
+
+    def test_env_config(self, monkeypatch):
+        monkeypatch.setenv("DTT_PREEMPTION_SIGNALS", "SIGUSR2,SIGTERM")
+        monkeypatch.setenv("DTT_GRACE_PERIOD_S", "7.5")
+        cfg = TerminationConfig.from_env()
+        assert signal.SIGUSR2 in cfg.signals and signal.SIGTERM in cfg.signals
+        assert cfg.grace_period_s == 7.5
+
+
+class TestPreemptionCheckpointHook:
+    def test_preemption_saves_and_stops_then_resumes(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1,
+                                async_save=False)
+        watcher = PreemptionWatcher(TerminationConfig(signals=()))
+        hook = PreemptionCheckpointHook(mgr, watcher, sync_every=5)
+
+        state = make_linear_state()
+        step = make_train_step(quadratic_loss, precision=FP32)
+        data = iter(lambda: linear_batch(), None)
+
+        class TriggerAt(Hook):
+            def after_step(self, loop, s, m):
+                if s == 7:
+                    watcher.signal_preemption()
+
+        loop = TrainLoop(step, state, data,
+                         hooks=[TriggerAt(), hook], metrics_every=1)
+        final = loop.run(100)
+        stopped_at = int(jax.device_get(final.step))
+        assert stopped_at == 10  # next sync point after step 7
+        assert hook.handled
+        assert mgr.latest_step() == 10
+
+        # restart: resume from the preemption checkpoint
+        state2 = make_linear_state()
+        restored = mgr.restore_or_init(state2)
+        assert int(jax.device_get(restored.step)) == 10
+        mgr.close()
+
+
+class TestHealthChecker:
+    def test_failure_after_consecutive_probes(self):
+        calls = []
+        hc = HealthChecker(
+            interval_s=0.01, failures_before_action=2,
+            probe=lambda t: False, on_failure=lambda: calls.append(1),
+        )
+        hc.start()
+        deadline = time.time() + 5
+        while hc.error is None and time.time() < deadline:
+            time.sleep(0.01)
+        hc.stop()
+        assert hc.error is not None
+        assert calls == [1]
+        with pytest.raises(RuntimeError):
+            hc.raise_if_unhealthy()
+
+    def test_recovery_resets_counter(self):
+        results = iter([False, True, False, True, True])
+        hc = HealthChecker(
+            interval_s=0.01, failures_before_action=2,
+            probe=lambda t: next(results, True),
+        )
+        hc.start()
+        time.sleep(0.3)
+        hc.stop()
+        assert hc.error is None
+        hc.raise_if_unhealthy()  # no raise
+
+
+SUBPROC_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+args = TrainArgs(
+    model="mnist", steps=100000, batch_size=32,
+    checkpoint_dir=sys.argv[1], checkpoint_every=20, log_every=10,
+)
+run(args)
+"""
+
+
+class TestKillAWorker:
+    def test_sigterm_mid_training_checkpoints_and_resumes(self, tmp_path):
+        """Fault injection: real process, real SIGTERM, real resume."""
+        ckpt = str(tmp_path / "ckpt")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", SUBPROC_SCRIPT, ckpt],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        # give it time to compile and pass a few checkpoint intervals
+        time.sleep(60)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            pytest.fail(f"worker did not exit after SIGTERM; output:\n{out[-3000:]}")
+        assert "preemption" in out.lower(), out[-3000:]
+
+        steps = sorted(
+            int(d) for d in os.listdir(ckpt) if d.isdigit()
+        ) if os.path.isdir(ckpt) else []
+        assert steps, f"no checkpoint written; output:\n{out[-3000:]}"
+
+        # restart: must resume from the saved step, not step 0
+        env2 = dict(env)
+        proc2 = subprocess.run(
+            [sys.executable, "-c", SUBPROC_SCRIPT.replace("100000",
+             str(steps[-1] + 5)), ckpt],
+            env=env2, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=300,
+        )
+        assert f"resumed from checkpoint step {steps[-1]}" in proc2.stdout, (
+            proc2.stdout[-3000:]
+        )

@@ -126,6 +126,21 @@ class TestGPT2:
         l_tp = [m["loss"] for m in run_steps(self._tiny(), mesh_2d, 3)[1]]
         np.testing.assert_allclose(l_dp, l_tp, rtol=2e-2)
 
+    def test_context_parallel_ring_attention_matches_dp(self, mesh_dp, mesh_4d):
+        # mesh_4d has context=2: GPT-2 switches to ring attention. Loss must
+        # match the dense-attention DP run (exact attention either way).
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        def make(mesh):
+            return get_workload(
+                "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+                grad_accum_steps=1, mesh=mesh,
+            )
+
+        l_dp = [m["loss"] for m in run_steps(make(None), mesh_dp, 3)[1]]
+        l_cp = [m["loss"] for m in run_steps(make(mesh_4d), mesh_4d, 3)[1]]
+        np.testing.assert_allclose(l_dp, l_cp, rtol=2e-2)
+
     def test_grad_accum_runs(self, mesh_dp):
         wl = self._tiny(grad_accum_steps=2)
         state, hist = run_steps(wl, mesh_dp, 3, grad_accum=2)

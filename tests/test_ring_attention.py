@@ -1,0 +1,80 @@
+"""Ring attention correctness: the sharded ring program must equal dense
+softmax attention (it is exact attention, not an approximation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+from distributed_tensorflow_tpu.parallel.ring_attention import (
+    _dense_attention,
+    ring_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_ctx():
+    import jax
+
+    return build_mesh(MeshConfig(data=1, context=8), jax.devices())
+
+
+def make_qkv(B=2, T=32, H=4, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, mesh_ctx, causal):
+        q, k, v = make_qkv()
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        got = ring_attention(qs, ks, vs, mesh=mesh_ctx, causal=causal)
+        want = _dense_attention(q, k, v, causal=causal,
+                                scale=1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_output_stays_sequence_sharded(self, mesh_ctx):
+        q, k, v = make_qkv()
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = jax.jit(
+            lambda a, b, c: ring_attention(a, b, c, mesh=mesh_ctx)
+        )(qs, ks, vs)
+        assert not out.sharding.is_fully_replicated
+
+    def test_gradients_match_dense(self, mesh_ctx):
+        q, k, v = make_qkv(T=16)
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh=mesh_ctx,
+                                          causal=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense_attention(
+                q, k, v, causal=True, scale=1.0 / np.sqrt(q.shape[-1])) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qs, ks, vs)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gd), rtol=1e-4, atol=1e-4
+            )
+
+    def test_single_device_axis_falls_back(self, mesh_dp):
+        # mesh without a context axis (size 1) → dense path
+        q, k, v = make_qkv(T=8)
+        out = ring_attention(q, k, v, mesh=mesh_dp, causal=True)
+        want = _dense_attention(q, k, v, causal=True,
+                                scale=1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-6)

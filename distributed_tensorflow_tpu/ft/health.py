@@ -15,9 +15,9 @@ a callback (default: log + raise in the caller thread via a stored error).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
-import time
 from typing import Callable, Optional
 
 import jax
@@ -25,26 +25,35 @@ import jax
 logger = logging.getLogger(__name__)
 
 
-def default_probe(timeout_s: float) -> bool:
-    """True if the cluster looks healthy.
+def make_default_probe():
+    """Build the default cluster probe.
 
     Multi-process: run a named barrier; all live hosts enter it within the
     timeout (mirrors TF's CheckHealth RPC semantics at the controller level).
+    The barrier id is a per-probe round counter — every host's checker
+    produces the same sequence, so round k on host A meets round k on host B
+    (a wall-clock id would never match across hosts).
     Single-process: trivially healthy.
     """
-    if jax.process_count() <= 1:
-        return True
-    try:
-        client = jax._src.distributed.global_state.client
-        if client is None:
+    round_counter = itertools.count()
+
+    def probe(timeout_s: float) -> bool:
+        if jax.process_count() <= 1:
             return True
-        client.wait_at_barrier(
-            f"dtt_health_{int(time.time())}", timeout_in_ms=int(timeout_s * 1000)
-        )
-        return True
-    except Exception as e:  # barrier timeout / peer gone
-        logger.error("health probe failed: %s", e)
-        return False
+        rid = next(round_counter)
+        try:
+            client = jax._src.distributed.global_state.client
+            if client is None:
+                return True
+            client.wait_at_barrier(
+                f"dtt_health_{rid}", timeout_in_ms=int(timeout_s * 1000)
+            )
+            return True
+        except Exception as e:  # barrier timeout / peer gone
+            logger.error("health probe failed: %s", e)
+            return False
+
+    return probe
 
 
 class HealthChecker:
@@ -67,7 +76,7 @@ class HealthChecker:
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.failures_before_action = failures_before_action
-        self._probe = probe or default_probe
+        self._probe = probe or make_default_probe()
         self._on_failure = on_failure
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -187,12 +187,17 @@ def make_workload(
     cfg = config or getattr(GPT2Config, preset)()
     seq = seq_len or min(cfg.n_positions, 1024)
     module = GPT2(cfg, mesh=mesh)
+    # Init batch must divide over the batch-sharding axes (ring attention is
+    # a shard_map program with static per-shard shapes), like wide_deep.
+    b0 = 2
+    if mesh is not None:
+        b0 = max(2, mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
     return Workload(
         name="gpt2",
         module=module,
         loss_fn=functools.partial(_loss_fn, module, False),
         eval_loss_fn=functools.partial(_loss_fn, module, True),
-        init_batch={"tokens": np.zeros((2, seq), np.int32)},
+        init_batch={"tokens": np.zeros((b0, seq), np.int32)},
         data_fn=lambda per_host_bs: synthetic_lm(
             batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
         ),

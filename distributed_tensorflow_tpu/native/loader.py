@@ -1,0 +1,225 @@
+"""ctypes binding for the native record loader, with build-on-first-use.
+
+Replaces the tf.data dependency for the fixed-size-record fast path (images,
+token blocks, recsys rows).  The sharding contract mirrors tf.data
+AutoShardPolicy.DATA ($TF/python/data/ops/options.py:89 — SURVEY.md §3.4):
+record i belongs to shard ``i % shard_count``.
+
+Falls back to a numpy implementation with identical semantics when a C++
+toolchain is unavailable (``native_available()`` reports which is active).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "dtt_loader.cpp")
+_LIB_CACHE: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+_LOCK = threading.Lock()
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "DTT_NATIVE_BUILD_DIR",
+        os.path.join(os.path.dirname(__file__), "_build"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    """Compile (once) and dlopen the loader library."""
+    global _LIB_CACHE, _LIB_TRIED
+    with _LOCK:
+        if _LIB_TRIED:
+            return _LIB_CACHE
+        _LIB_TRIED = True
+        so_path = os.path.join(_build_dir(), "libdtt_loader.so")
+        try:
+            if (not os.path.exists(so_path)
+                    or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+                cmd = [
+                    "g++", "-O3", "-shared", "-fPIC", "-pthread",
+                    "-std=c++17", _SRC, "-o", so_path + ".tmp",
+                ]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(so_path + ".tmp", so_path)
+            lib = ctypes.CDLL(so_path)
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native loader unavailable (%s); using numpy "
+                           "fallback", e)
+            return None
+        lib.dtt_loader_create.restype = ctypes.c_void_p
+        lib.dtt_loader_create.argtypes = [
+            ctypes.c_char_p] + [ctypes.c_uint64] * 8
+        lib.dtt_loader_num_records.restype = ctypes.c_uint64
+        lib.dtt_loader_num_records.argtypes = [ctypes.c_void_p]
+        lib.dtt_loader_next.restype = ctypes.c_int
+        lib.dtt_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ]
+        lib.dtt_loader_destroy.restype = None
+        lib.dtt_loader_destroy.argtypes = [ctypes.c_void_p]
+        _LIB_CACHE = lib
+        return lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class RecordFile:
+    """Fixed-size-record file: the loader's on-disk format.
+
+    A record is one example: the concatenation of each field's fixed-size
+    little-endian buffer.  ``write()`` stages numpy batches into the format;
+    training jobs usually write once (or convert) and read many times.
+    """
+
+    def __init__(self, fields: Sequence[Tuple[str, Tuple[int, ...], np.dtype]]):
+        self.fields = [(n, tuple(s), np.dtype(d)) for n, s, d in fields]
+        self.record_bytes = sum(
+            int(np.prod(s)) * d.itemsize for _, s, d in self.fields
+        )
+
+    def write(self, path: str, arrays: dict, *, append: bool = False) -> int:
+        ns = {len(arrays[n]) for n, _, _ in self.fields}
+        assert len(ns) == 1, "all fields must have the same leading dim"
+        n = ns.pop()
+        mode = "ab" if append else "wb"
+        with open(path, mode) as f:
+            for i in range(n):
+                for name, shape, dtype in self.fields:
+                    a = np.asarray(arrays[name][i], dtype=dtype)
+                    assert a.shape == shape, (name, a.shape, shape)
+                    f.write(np.ascontiguousarray(a).tobytes())
+        return n
+
+    def unpack(self, flat: np.ndarray) -> dict:
+        """(batch, record_bytes) uint8 -> dict of typed field arrays."""
+        out = {}
+        offset = 0
+        B = flat.shape[0]
+        for name, shape, dtype in self.fields:
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            chunk = flat[:, offset:offset + nbytes]
+            out[name] = np.ascontiguousarray(chunk).view(dtype).reshape(
+                (B,) + shape
+            )
+            offset += nbytes
+        return out
+
+
+class NativeRecordLoader:
+    """Iterator of shuffled, sharded, prefetched batches from a RecordFile.
+
+    C++ fast path when the toolchain allows; numpy fallback otherwise.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        record: RecordFile,
+        *,
+        batch_size: int,
+        shuffle: bool = True,
+        num_threads: int = 2,
+        prefetch: int = 4,
+        seed: int = 0,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
+    ):
+        import jax
+
+        self.record = record
+        self.batch_size = batch_size
+        self._shard_index = (
+            shard_index if shard_index is not None else jax.process_index()
+        )
+        self._shard_count = (
+            shard_count if shard_count is not None else jax.process_count()
+        )
+        self._lib = _load_library()
+        self._handle = None
+        self._out = np.empty(
+            (batch_size, record.record_bytes), dtype=np.uint8
+        )
+        if self._lib is not None:
+            self._handle = self._lib.dtt_loader_create(
+                path.encode(), record.record_bytes, batch_size,
+                int(shuffle), num_threads, prefetch, seed,
+                self._shard_index, self._shard_count,
+            )
+            if not self._handle:
+                raise FileNotFoundError(
+                    f"native loader could not open {path!r} (missing, empty, "
+                    f"or shard {self._shard_index}/{self._shard_count} holds "
+                    "no records)"
+                )
+            self.num_records = int(
+                self._lib.dtt_loader_num_records(self._handle)
+            )
+        else:
+            data = np.fromfile(path, dtype=np.uint8)
+            n = data.size // record.record_bytes
+            if n == 0:
+                raise FileNotFoundError(f"no records in {path!r}")
+            data = data[: n * record.record_bytes].reshape(
+                n, record.record_bytes
+            )
+            self._records = data[self._shard_index::self._shard_count]
+            if len(self._records) == 0:
+                raise FileNotFoundError(
+                    f"shard {self._shard_index}/{self._shard_count} empty"
+                )
+            self.num_records = len(self._records)
+            self._rng = np.random.RandomState(seed)
+            self._shuffle = shuffle
+            self._order = np.arange(self.num_records)
+            self._cursor = self.num_records  # force initial shuffle
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        if self._handle is not None:
+            rc = self._lib.dtt_loader_next(
+                self._handle,
+                self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._out.nbytes,
+            )
+            if rc != 0:
+                raise StopIteration
+            return self.record.unpack(self._out)
+        # numpy fallback
+        idx = np.empty(self.batch_size, np.int64)
+        for i in range(self.batch_size):
+            if self._cursor >= self.num_records:
+                if self._shuffle:
+                    self._rng.shuffle(self._order)
+                self._cursor = 0
+            idx[i] = self._order[self._cursor]
+            self._cursor += 1
+        return self.record.unpack(self._records[idx])
+
+    def close(self) -> None:
+        if self._handle is not None and self._lib is not None:
+            self._lib.dtt_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

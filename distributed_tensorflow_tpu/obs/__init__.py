@@ -1,0 +1,27 @@
+"""Observability: TensorBoard metrics, profiling, throughput counters.
+
+Behavioral model (SURVEY.md §6.1, §6.5): TF1 hooks (LoggingTensorHook,
+StepCounterHook, SummarySaverHook — basic_session_run_hooks.py:169,:674,:793)
++ ``tf.summary``/TensorBoard, and ``tf.profiler.experimental``
+(profiler_v2.py:81: start/stop, :169: start_server for remote capture).
+
+TPU-native: metrics come off the compiled step at throttled intervals
+(training.loop), get written via tensorboardX; traces come from
+``jax.profiler`` into the same TensorBoard profile plugin.
+"""
+
+from distributed_tensorflow_tpu.obs.tensorboard import (
+    MetricsFileWriter,
+    TensorBoardHook,
+)
+from distributed_tensorflow_tpu.obs.profiling import (
+    Profile,
+    start_profiler_server,
+)
+
+__all__ = [
+    "MetricsFileWriter",
+    "Profile",
+    "TensorBoardHook",
+    "start_profiler_server",
+]

@@ -1,0 +1,44 @@
+"""Profiling: jax.profiler wrappers matching tf.profiler.experimental.
+
+- ``Profile``: context manager around a trace window
+  (tf.profiler.experimental.Profile, profiler_v2.py:184 equivalent).
+- ``start_profiler_server``: in-process profiler endpoint for on-demand
+  remote capture (profiler_v2.py:169 equivalent) — point TensorBoard's
+  profile plugin or ``jax.profiler.trace`` clients at it.
+- ``ProfilerHook`` (training.loop) covers the scripted step-window case.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_SERVER = None
+
+
+def start_profiler_server(port: int = 9012):
+    """Start the profiler gRPC endpoint once; returns the server handle."""
+    global _SERVER
+    if _SERVER is None:
+        _SERVER = jax.profiler.start_server(port)
+        logger.info("profiler server listening on :%d", port)
+    return _SERVER
+
+
+class Profile:
+    """``with Profile(logdir):`` traces the enclosed steps into TensorBoard."""
+
+    def __init__(self, log_dir: str, *, host_tracer_level: Optional[int] = None):
+        self.log_dir = log_dir
+
+    def __enter__(self):
+        jax.profiler.start_trace(self.log_dir)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        jax.profiler.stop_trace()
+        return False

@@ -1,0 +1,76 @@
+"""TensorBoard + JSONL metric writers (SummarySaverHook equivalents)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+from distributed_tensorflow_tpu.training.loop import Hook
+
+logger = logging.getLogger(__name__)
+
+
+class TensorBoardHook(Hook):
+    """Writes step metrics as TensorBoard scalars (tf.summary equivalent).
+
+    Only the coordinator process writes (TF: chief-only summaries), so pod
+    runs don't produce N duplicate event streams.
+    """
+
+    def __init__(self, log_dir: str, *, every_steps: int = 10):
+        self.log_dir = log_dir
+        self.every_steps = max(1, every_steps)
+        self._writer = None
+
+    def begin(self, loop):
+        if jax.process_index() != 0:
+            return
+        from tensorboardX import SummaryWriter
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._writer = SummaryWriter(self.log_dir)
+
+    def after_step(self, loop, step, metrics: Optional[Dict[str, float]]):
+        if self._writer is None or metrics is None:
+            return
+        if step % self.every_steps:
+            return
+        for k, v in metrics.items():
+            self._writer.add_scalar(f"train/{k}", v, global_step=step)
+
+    def end(self, loop, step):
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()
+            self._writer = None
+
+
+class MetricsFileWriter(Hook):
+    """Append-only JSONL metrics (machine-readable run record)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def begin(self, loop):
+        if jax.process_index() != 0:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f = open(self.path, "a")
+
+    def after_step(self, loop, step, metrics):
+        if self._f is None or metrics is None:
+            return
+        self._f.write(json.dumps(
+            {"step": step, "time": time.time(), **metrics}
+        ) + "\n")
+
+    def end(self, loop, step):
+        if self._f is not None:
+            self._f.close()
+            self._f = None

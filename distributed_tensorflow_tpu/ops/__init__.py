@@ -1,0 +1,11 @@
+"""Custom TPU kernels (Pallas).
+
+The reference's custom-kernel layer is CUDA inside TF's binary (SURVEY.md §2
+L0); the TPU-native equivalent is Pallas — kernels that tile HBM→VMEM
+explicitly and drive the MXU per block.  Everything here has a pure-XLA
+fallback so CPU tests and non-TPU platforms keep working.
+"""
+
+from distributed_tensorflow_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,184 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Why a kernel at all: XLA materializes the (T, T) score matrix in HBM for the
+naive einsum formulation; the flash formulation streams K/V blocks through
+VMEM with an online softmax, so HBM traffic is O(T·D) and the score tile
+lives entirely on-chip feeding the MXU.  (The reference's equivalent layer is
+fused CUDA attention inside TF's binary — SURVEY.md §2 L0.)
+
+Design:
+
+- Grid: (batch·heads, T/BLOCK_Q).  Each program owns one query block and
+  loops over key blocks in VMEM; running max / denominator / accumulator are
+  f32 VMEM scratch.
+- Causal masking is positional inside the tile; with ``causal=True`` key
+  blocks entirely above the diagonal are skipped by loop bound, not masked —
+  ~2x fewer tiles for long sequences.
+- Backward: ``jax.custom_vjp`` whose bwd recomputes through the dense XLA
+  formulation.  Training long sequences should use
+  ``parallel.ring_attention`` (which shards T); this kernel's win is forward
+  throughput and memory (scoring, inference, short-to-mid T training fwd).
+- Non-TPU platforms and awkward shapes fall back to the dense XLA path with
+  identical numerics (f32 softmax).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    """DTT_PALLAS_INTERPRET=1 runs the kernel in the Pallas interpreter —
+    the CPU-test path for kernel logic (real lowering is TPU-only)."""
+    return os.environ.get("DTT_PALLAS_INTERPRET", "") == "1"
+
+
+def _dense(q, k, v, *, causal, scale):
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len, causal, scale,
+            block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    D = q.shape[-1]
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # highest key block that intersects the causal triangle of this
+        # q block: floor(((qi+1)*block_q - 1) / block_k) + 1
+        hi = ((qi + 1) * block_q - 1) // block_k + 1
+        hi = jnp.minimum(hi, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_safe, l
+
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_tpu(q, k, v, *, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    block_q = min(BLOCK_Q, T)
+    block_k = min(BLOCK_K, T)
+    # (B, T, H, D) -> (B*H, T, D)
+    def to_heads(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    grid = (B * H, pl.cdiv(T, block_q))
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, seq_len=T, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(qh, kh, vh)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _supported(q, causal):
+    B, T, H, D = q.shape
+    if jax.devices()[0].platform != "tpu" and not _interpret():
+        return False
+    if T % min(BLOCK_Q, T) or T % min(BLOCK_K, T):
+        return False
+    return D in (64, 128, 256) or D % 128 == 0 or _interpret()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    if _supported(q, causal):
+        return _flash_fwd_tpu(q, k, v, causal=causal, scale=scale)
+    return _dense(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    return _flash(q, k, v, causal, scale)

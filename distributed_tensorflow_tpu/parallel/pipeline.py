@@ -57,8 +57,9 @@ def pipeline_apply(
     """Run ``x`` through S pipelined stages.
 
     stacked_params: leaves of shape (S, ...), sharded over ``axis``.
-    x: (M, microbatch, ...) — M microbatches (global, replicated or
-       batch-sharded on the microbatch dim over data axes).
+    x: (M, microbatch, ...) — M microbatches, replicated across the mesh
+       for this call (combine with data parallelism by vmapping/jitting this
+       function over a batch-sharded outer dim).
     Returns (M, microbatch, ...) = stage_{S-1}(...stage_0(x)), replicated
     over ``axis``.
     """

@@ -68,6 +68,8 @@ class TrainArgs:
     checkpoint_every: int = 1000
     log_every: int = 50
     profile_dir: Optional[str] = None
+    tensorboard_dir: Optional[str] = None
+    metrics_file: Optional[str] = None
     seed: int = 0
 
 
@@ -92,6 +94,8 @@ def parse_args(argv=None) -> TrainArgs:
     p.add_argument("--checkpoint_every", type=int, default=1000)
     p.add_argument("--log_every", type=int, default=50)
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--tensorboard_dir", type=str, default=None)
+    p.add_argument("--metrics_file", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
     ns = p.parse_args(argv)
     return TrainArgs(**vars(ns))
@@ -242,6 +246,15 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         hooks.append(PreemptionCheckpointHook(manager))
     if args.profile_dir:
         hooks.append(ProfilerHook(args.profile_dir))
+    if args.tensorboard_dir:
+        from distributed_tensorflow_tpu.obs import TensorBoardHook
+
+        hooks.append(TensorBoardHook(args.tensorboard_dir,
+                                     every_steps=args.log_every))
+    if args.metrics_file:
+        from distributed_tensorflow_tpu.obs import MetricsFileWriter
+
+        hooks.append(MetricsFileWriter(args.metrics_file))
 
     # 6. Loop.
     loop = TrainLoop(

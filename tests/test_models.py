@@ -126,6 +126,20 @@ class TestGPT2:
         l_tp = [m["loss"] for m in run_steps(self._tiny(), mesh_2d, 3)[1]]
         np.testing.assert_allclose(l_dp, l_tp, rtol=2e-2)
 
+    def test_context_parallel_with_data4_mesh_inits(self):
+        # regression: init batch must divide over data axes when the mesh
+        # forces the ring-attention shard_map path (data=4, context=2)
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        mesh = build_mesh(MeshConfig(data=4, context=2), jax.devices())
+        wl = get_workload(
+            "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+            grad_accum_steps=1, mesh=mesh,
+        )
+        state, hist = run_steps(wl, mesh, 2)
+        assert np.isfinite(hist[-1]["loss"])
+
     def test_context_parallel_ring_attention_matches_dp(self, mesh_dp, mesh_4d):
         # mesh_4d has context=2: GPT-2 switches to ring attention. Loss must
         # match the dense-attention DP run (exact attention either way).

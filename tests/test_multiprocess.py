@@ -1,0 +1,98 @@
+"""Tier-(c) distributed tests (SURVEY.md §5): REAL multi-process cluster on
+localhost — the JAX analog of TF's create_in_process_cluster/
+MultiProcessRunner tests.  Two controller processes, TF_CONFIG contract,
+jax.distributed coordination, cross-process collective, and the
+collective-mismatch guard.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+
+resolver = cluster_lib.resolve()
+server = cluster_lib.Server.from_resolver(resolver)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+# cross-process host allgather
+from jax.experimental import multihost_utils
+vals = multihost_utils.process_allgather(
+    np.asarray([jax.process_index() + 1], np.int32)
+)
+assert int(np.asarray(vals).sum()) == 3, vals
+
+# collective-mismatch guard agrees on identical programs
+cluster_lib.assert_same_program("mp_test", {"shape": (4, 4)})
+
+# global-mesh computation: one sharded array over 4 devices, global sum
+from jax.sharding import NamedSharding, PartitionSpec as P
+mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=4))
+sh = NamedSharding(mesh, P("data"))
+local = np.arange(2, dtype=np.float32) + 2 * jax.process_index()
+garr = jax.make_array_from_process_local_data(sh, local)
+total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(garr)
+assert float(total) == 0 + 1 + 2 + 3, float(total)
+
+server.shutdown()
+print("MP_OK", jax.process_index())
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_localhost_cluster(tmp_path):
+    import json
+
+    p0, p1 = _free_port(), _free_port()
+    cluster = {"worker": [f"localhost:{p0}", f"localhost:{p1}"]}
+    procs = []
+    for idx in range(2):
+        env = dict(
+            os.environ,
+            TF_CONFIG=json.dumps(
+                {"cluster": cluster, "task": {"type": "worker", "index": idx}}
+            ),
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER_SCRIPT],
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(__file__)),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers hung")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
+        assert f"MP_OK {i}" in out, out[-2000:]

@@ -1,0 +1,56 @@
+"""Observability tests: TensorBoard/JSONL writers and profiler wrappers."""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_tpu.obs import (
+    MetricsFileWriter,
+    Profile,
+    TensorBoardHook,
+)
+from distributed_tensorflow_tpu.training import FP32, TrainLoop, make_train_step
+from tests.test_training import linear_batch, make_linear_state, quadratic_loss
+
+
+def run_loop(hooks, steps=12):
+    state = make_linear_state()
+    step = make_train_step(quadratic_loss, precision=FP32)
+    data = iter(lambda: linear_batch(), None)
+    loop = TrainLoop(step, state, data, hooks=hooks, metrics_every=2)
+    loop.run(steps)
+
+
+class TestTensorBoardHook:
+    def test_writes_event_files(self, tmp_path):
+        d = str(tmp_path / "tb")
+        run_loop([TensorBoardHook(d, every_steps=2)])
+        files = os.listdir(d)
+        assert any("tfevents" in f for f in files), files
+
+
+class TestMetricsFileWriter:
+    def test_writes_parseable_jsonl(self, tmp_path):
+        p = str(tmp_path / "metrics.jsonl")
+        run_loop([MetricsFileWriter(p)])
+        lines = [json.loads(l) for l in open(p)]
+        assert lines, "no metrics written"
+        assert all("step" in l and "loss" in l for l in lines)
+        steps = [l["step"] for l in lines]
+        assert steps == sorted(steps)
+
+
+class TestProfile:
+    def test_trace_context_manager(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "prof")
+        with Profile(d):
+            jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+        found = []
+        for root, _, files in os.walk(d):
+            found += [f for f in files if f.endswith((".pb", ".json.gz",
+                                                      ".xplane.pb"))]
+        assert found, f"no trace artifacts under {d}"

@@ -126,6 +126,7 @@ def synthetic_image_classification(
     num_classes: int = 10,
     seed: int = 0,
     dtype=np.float32,
+    holdout: bool = False,
 ) -> Iterator[Batch]:
     """Deterministic synthetic (image, label) stream, per-host decorrelated.
 
@@ -134,7 +135,9 @@ def synthetic_image_classification(
     learn — loss decrease is a real end-to-end signal, not noise.
     """
     num_shards, index = shard_options()
-    rng = np.random.RandomState(seed * 1009 + index)
+    # holdout: a disjoint noise/label stream over the SAME task (templates
+    # unchanged) — the eval split.
+    rng = np.random.RandomState(seed * 1009 + index + (500_009 if holdout else 0))
     # Class templates are seed-derived but host-independent so every host
     # draws from the same distribution (only the noise/labels differ).
     tmpl_rng = np.random.RandomState(seed)
@@ -152,10 +155,11 @@ def synthetic_lm(
     seq_len: int,
     vocab_size: int,
     seed: int = 0,
+    holdout: bool = False,
 ) -> Iterator[Batch]:
     """Synthetic token stream with local structure (next-token ≈ f(prev))."""
     num_shards, index = shard_options()
-    rng = np.random.RandomState(seed * 2003 + index)
+    rng = np.random.RandomState(seed * 2003 + index + (500_009 if holdout else 0))
     while True:
         start = rng.randint(0, vocab_size, size=(batch_size, 1))
         steps = rng.randint(1, 7, size=(batch_size, seq_len))
@@ -171,6 +175,7 @@ def synthetic_mlm(
     mask_token: int = 1,
     mask_rate: float = 0.15,
     seed: int = 0,
+    holdout: bool = False,
 ) -> Iterator[Batch]:
     """BERT-pretraining-style stream: masked tokens + segment ids + NSP label.
 
@@ -179,7 +184,7 @@ def synthetic_mlm(
     first sequence or is an independent draw.
     """
     num_shards, index = shard_options()
-    rng = np.random.RandomState(seed * 3001 + index)
+    rng = np.random.RandomState(seed * 3001 + index + (500_009 if holdout else 0))
     half = seq_len // 2
     while True:
         start = rng.randint(2, vocab_size, size=(batch_size, 1))
@@ -214,11 +219,15 @@ def synthetic_recsys(
     num_sparse: int = 26,
     vocab_size: int = 100_000,
     seed: int = 0,
+    holdout: bool = False,
 ) -> Iterator[Batch]:
     """DLRM/Wide&Deep-style: dense features + categorical ids + CTR label."""
     num_shards, index = shard_options()
-    rng = np.random.RandomState(seed * 4001 + index)
-    w_dense = rng.randn(num_dense).astype(np.float32)
+    # The CTR weight vector defines the task: derive it from `seed` alone so
+    # train and holdout streams share it, then fork the sample stream.
+    task_rng = np.random.RandomState(seed * 4001)
+    w_dense = task_rng.randn(num_dense).astype(np.float32)
+    rng = np.random.RandomState(seed * 4001 + index + (500_009 if holdout else 0))
     while True:
         dense = rng.randn(batch_size, num_dense).astype(np.float32)
         sparse = rng.randint(0, vocab_size, size=(batch_size, num_sparse))

@@ -1,0 +1,135 @@
+"""ClusterCoordinator: the TF2 PS dispatch surface, single-controller style.
+
+Behavioral model: ``coordinator/cluster_coordinator.py:1399`` —
+``schedule(fn, args)`` returns a ``RemoteValue`` future, ``join()`` drains
+the queue, ``fetch()`` materializes results; worker failure re-queues the
+closure (``WorkerPreemptionHandler``, :841 — SURVEY.md §4.3).
+
+TPU-native: there are no per-worker graphs to dispatch to — the mesh *is*
+the worker pool and a scheduled step function is one jitted global program.
+What survives is the asynchrony contract: schedule returns immediately,
+execution is pipelined (JAX dispatch is async already; a worker thread
+keeps the queue draining), failures re-run the closure up to
+``max_retries`` (the re-queue semantics), and fetch/join block.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class RemoteValue:
+    """Future for a scheduled closure (cluster_coordinator.py RemoteValue)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _set(self, value):
+        self._value = value
+        self._event.set()
+
+    def _set_error(self, err: BaseException):
+        self._error = err
+        self._event.set()
+
+    def fetch(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("RemoteValue not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class ClusterCoordinator:
+    """schedule/join/fetch with retry-on-failure semantics."""
+
+    def __init__(self, strategy=None, *, max_retries: int = 1):
+        self.strategy = strategy
+        self.max_retries = max_retries
+        self._queue: "queue.Queue" = queue.Queue()
+        self._pending = 0
+        self._lock = threading.Condition()
+        self._closed = False
+        self._first_error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._drain, name="dtt-coordinator", daemon=True
+        )
+        self._thread.start()
+
+    def schedule(self, fn: Callable, args: tuple = (),
+                 kwargs: Optional[dict] = None) -> RemoteValue:
+        """Queue a closure; returns immediately (cluster_coordinator:1493)."""
+        rv = RemoteValue()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("coordinator is shut down")
+            self._pending += 1
+        self._queue.put((fn, args, kwargs or {}, rv, 0))
+        return rv
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until every scheduled closure finished (:1565).  Raises the
+        first closure error, matching TF (schedule errors surface in
+        join/schedule, not silently)."""
+        with self._lock:
+            if not self._lock.wait_for(
+                lambda: self._pending == 0, timeout=timeout
+            ):
+                raise TimeoutError("closures still pending")
+            if self._first_error is not None:
+                err, self._first_error = self._first_error, None
+                raise err
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._pending == 0
+
+    def fetch(self, val):
+        """Materialize RemoteValues in a structure (:1695)."""
+        import jax
+
+        return jax.tree.map(
+            lambda v: v.fetch() if isinstance(v, RemoteValue) else v, val,
+            is_leaf=lambda v: isinstance(v, RemoteValue),
+        )
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, args, kwargs, rv, attempt = item
+            try:
+                result = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — closure errors retry
+                if attempt < self.max_retries:
+                    logger.warning(
+                        "closure failed (attempt %d): %s; re-queueing",
+                        attempt + 1, e,
+                    )
+                    self._queue.put((fn, args, kwargs, rv, attempt + 1))
+                    continue
+                rv._set_error(e)
+                with self._lock:
+                    if self._first_error is None:
+                        self._first_error = e
+                    self._pending -= 1
+                    self._lock.notify_all()
+                continue
+            rv._set(result)
+            with self._lock:
+                self._pending -= 1
+                self._lock.notify_all()

@@ -1,0 +1,204 @@
+"""Strategy classes: the tf.distribute surface on one TPU-native mechanism.
+
+Semantic mapping (TF behavior → here):
+
+- ``scope()``: TF enters a variable-creation scope so variables become
+  Mirrored/Sharded ($TF/python/distribute/distribute_lib.py:1223).  Here
+  placement is a *property of arrays*, not a creation-time mode: ``scope()``
+  records the strategy as current and returns a context manager; arrays the
+  user creates inside can be placed with ``strategy.place(tree, rules)``.
+- ``run(fn, args)``: TF runs fn per-replica (distribute_lib.py:1557).  Here
+  ``run`` jits fn over the strategy's mesh with batch args sharded on the
+  data axes — the per-replica program IS the global program, replicas are
+  shards.
+- ``reduce(op, value, axis)``: TF reduces PerReplica values to the host
+  (distribute_lib.py:1675).  Here values are global arrays; reduce is a jnp
+  reduction (mean/sum) over the batch dim.
+- ``experimental_distribute_dataset``: TF wraps a tf.data pipeline with
+  auto-sharding (input_lib.py:729).  Here it maps a per-host iterator of
+  numpy batches to global sharded arrays (data.pipeline contract).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+from distributed_tensorflow_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_sharding,
+)
+
+PyTree = Any
+
+_CURRENT = threading.local()
+
+
+def get_strategy() -> Optional["Strategy"]:
+    """The innermost active strategy (tf.distribute.get_strategy equiv)."""
+    return getattr(_CURRENT, "strategy", None)
+
+
+class Strategy:
+    """Base distribution strategy over a named-axis mesh."""
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self._mesh = mesh if mesh is not None else build_mesh(MeshConfig())
+        self._rules = ShardingRules()
+
+    # -- core tf.distribute surface ------------------------------------------
+    @contextlib.contextmanager
+    def scope(self):
+        prev = get_strategy()
+        _CURRENT.strategy = self
+        try:
+            yield self
+        finally:
+            _CURRENT.strategy = prev
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        """Data-parallel width (TF: number of replicas)."""
+        shape = self._mesh.shape
+        return shape.get("data", 1) * shape.get("fsdp", 1)
+
+    def run(self, fn: Callable, args: tuple = (), kwargs: dict = None):
+        """jit fn over the mesh; array args are placed before the call.
+
+        The whole "per-replica function + cross-replica sync" model of the
+        reference collapses here: fn sees global arrays and XLA partitions
+        it over the mesh (SURVEY.md §4.1 "TPU-native").
+        """
+        kwargs = kwargs or {}
+        bsh = self.batch_sharding()
+
+        def _place(x):
+            if isinstance(x, (np.ndarray, jax.Array)) and np.ndim(x) >= 1:
+                try:
+                    return jax.device_put(x, bsh)
+                except ValueError:  # batch dim not divisible: replicate
+                    return jax.device_put(x, NamedSharding(self._mesh, P()))
+            return x
+
+        args = jax.tree.map(_place, args)
+        kwargs = jax.tree.map(_place, kwargs)
+        return jax.jit(fn)(*args, **kwargs)
+
+    def reduce(self, reduce_op: str, value: PyTree, axis: Optional[int] = 0):
+        """MEAN/SUM reduction of a (batch-sharded) value to a scalar/host
+        value per leaf (distribute_lib.py:1675 semantics)."""
+        op = reduce_op.lower()
+        if op not in ("mean", "sum"):
+            raise ValueError(f"reduce_op must be MEAN or SUM, got {reduce_op}")
+        fn = jnp.mean if op == "mean" else jnp.sum
+        return jax.tree.map(
+            lambda x: fn(x) if axis is None else fn(x, axis=axis), value
+        )
+
+    def experimental_distribute_dataset(
+        self, per_host_iter: Iterable[dict]
+    ) -> Iterable[dict]:
+        """Per-host numpy batches -> global mesh-sharded jax.Arrays."""
+        return make_global_batches(per_host_iter, self.batch_sharding())
+
+    # -- TPU-native placement API --------------------------------------------
+    def batch_sharding(self) -> NamedSharding:
+        return batch_sharding(self._mesh)
+
+    def place(self, tree: PyTree, rules: Optional[ShardingRules] = None) -> PyTree:
+        """Place a pytree per the strategy's variable-placement policy
+        (the MirroredVariable / ShardedVariable creation-scope equivalent)."""
+        rules = rules or self._rules
+        shardings = rules.shardings_for(self._mesh, tree)
+        return jax.tree.map(jax.device_put, tree, shardings)
+
+    def replicate(self, tree: PyTree) -> PyTree:
+        sh = NamedSharding(self._mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+class MirroredStrategy(Strategy):
+    """Single-host sync data parallelism (mirrored_strategy.py:200).
+
+    Variables replicated, batch split over local devices, gradients
+    all-reduced — on TPU that is simply a data-axis mesh over local devices.
+    """
+
+    def __init__(self, devices: Optional[list] = None):
+        devices = devices if devices is not None else jax.local_devices()
+        super().__init__(build_mesh(MeshConfig(), devices))
+
+
+class MultiWorkerMirroredStrategy(Strategy):
+    """Multi-worker sync DP (collective_all_reduce_strategy.py:57) — the
+    ResNet-50/GPT-2 path.  The gRPC server + NCCL CollectiveAllReduce of the
+    reference become jax.distributed + an XLA AllReduce over ICI; the
+    cluster must already be resolved (cluster.resolve + Server), after which
+    every process sees the global device set."""
+
+    def __init__(self, cluster_resolver=None):
+        if cluster_resolver is not None and not cluster_resolver.is_compute_task():
+            raise ValueError(
+                "MultiWorkerMirroredStrategy on a non-compute task; ps tasks "
+                "should park in Server.join()"
+            )
+        super().__init__(build_mesh(MeshConfig()))
+
+
+class TPUStrategy(Strategy):
+    """tpu_strategy.py:668 equivalent: sync DP over all TPU cores."""
+
+    def __init__(self, mesh_config: Optional[MeshConfig] = None):
+        super().__init__(build_mesh(mesh_config or MeshConfig()))
+
+
+class OneDeviceStrategy(Strategy):
+    """one_device_strategy.py: everything on one device."""
+
+    def __init__(self, device=None):
+        device = device if device is not None else jax.devices()[0]
+        super().__init__(build_mesh(MeshConfig(data=1), [device]))
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        return 1
+
+
+class ParameterServerStrategy(Strategy):
+    """PS semantics without a PS runtime (parameter_server_strategy_v2.py:77).
+
+    The reference places variables on ps tasks and ships them over gRPC each
+    step (SURVEY.md §4.2 — the hot-loop RecvTensor).  Here "parameter
+    serving" means *sharded residence*: variables placed through this
+    strategy are partitioned over the mesh (embedding tables by vocab row,
+    large dense layers by fsdp) and XLA moves exactly the needed slices over
+    ICI.  ``variable_partitioner`` accepts the TF partitioner objects for
+    config compatibility (sharded_variable.py:84,:115,:176) — they inform
+    ``place()`` via a min-size threshold.
+    """
+
+    def __init__(self, cluster_resolver=None, variable_partitioner=None,
+                 mesh: Optional[Mesh] = None):
+        super().__init__(mesh if mesh is not None else build_mesh(MeshConfig()))
+        self._partitioner = variable_partitioner
+
+    def place(self, tree: PyTree, rules: Optional[ShardingRules] = None) -> PyTree:
+        if rules is not None:
+            return super().place(tree, rules)
+        from distributed_tensorflow_tpu.parallel.sharding import fsdp_sharding
+
+        axis = "fsdp" if self._mesh.shape.get("fsdp", 1) > 1 else "data"
+        shardings = fsdp_sharding(self._mesh, tree, axis=axis)
+        return jax.tree.map(jax.device_put, tree, shardings)

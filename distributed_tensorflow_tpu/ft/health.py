@@ -15,9 +15,9 @@ a callback (default: log + raise in the caller thread via a stored error).
 
 from __future__ import annotations
 
-import itertools
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -25,22 +25,25 @@ import jax
 logger = logging.getLogger(__name__)
 
 
-def make_default_probe():
+def make_default_probe(interval_s: float = 30.0):
     """Build the default cluster probe.
 
     Multi-process: run a named barrier; all live hosts enter it within the
     timeout (mirrors TF's CheckHealth RPC semantics at the controller level).
-    The barrier id is a per-probe round counter — every host's checker
-    produces the same sequence, so round k on host A meets round k on host B
-    (a wall-clock id would never match across hosts).
+    The barrier id is the wall clock quantized by the probe interval: hosts
+    probing on the same cadence agree on the id without any shared counter,
+    and — unlike a per-process counter — the id re-synchronizes by itself
+    after a host restarts or starts late (a counter desyncs permanently).
+    An occasional quantum-boundary mismatch shows up as one failed probe;
+    ``failures_before_action >= 2`` absorbs it.
     Single-process: trivially healthy.
     """
-    round_counter = itertools.count()
+    quantum = max(interval_s, 1.0)
 
     def probe(timeout_s: float) -> bool:
         if jax.process_count() <= 1:
             return True
-        rid = next(round_counter)
+        rid = int(time.time() // quantum)
         try:
             client = jax._src.distributed.global_state.client
             if client is None:
@@ -76,7 +79,7 @@ class HealthChecker:
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.failures_before_action = failures_before_action
-        self._probe = probe or make_default_probe()
+        self._probe = probe or make_default_probe(interval_s)
         self._on_failure = on_failure
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

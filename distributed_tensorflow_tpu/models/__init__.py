@@ -50,6 +50,9 @@ class Workload:
     # Optional optimizer factory: schedule -> optax.GradientTransformation.
     # None uses the framework default (adamw).
     make_optimizer: Optional[Callable[[Any], Any]] = None
+    # Held-out input stream for evaluation (same task, disjoint examples).
+    # None falls back to data_fn (eval-on-train; only for quick smoke runs).
+    eval_data_fn: Optional[Callable[[int], Iterator[Dict[str, Any]]]] = None
 
 
 _REGISTRY = {

@@ -205,6 +205,10 @@ def make_workload(
         data_fn=lambda per_host_bs: synthetic_mlm(
             batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
         ),
+        eval_data_fn=lambda per_host_bs: synthetic_mlm(
+            batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
+            holdout=True,
+        ),
         rules=bert_rules(),
         batch_size=batch_size,
         clip_grad_norm=1.0,

@@ -201,6 +201,10 @@ def make_workload(
         data_fn=lambda per_host_bs: synthetic_lm(
             batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
         ),
+        eval_data_fn=lambda per_host_bs: synthetic_lm(
+            batch_size=per_host_bs, seq_len=seq, vocab_size=cfg.vocab_size,
+            holdout=True,
+        ),
         rules=gpt2_rules(),
         batch_size=batch_size,
         grad_accum_steps=grad_accum_steps,

@@ -69,6 +69,10 @@ def make_workload(
             batch_size=per_host_bs, image_size=(28, 28, 1),
             num_classes=num_classes,
         ),
+        eval_data_fn=lambda per_host_bs: synthetic_image_classification(
+            batch_size=per_host_bs, image_size=(28, 28, 1),
+            num_classes=num_classes, holdout=True,
+        ),
         rules=ShardingRules(),  # small model: fully replicated (pure DP)
         batch_size=batch_size,
         learning_rate=1e-3,

@@ -165,6 +165,11 @@ def make_workload(
             image_size=(image_size, image_size, 3),
             num_classes=num_classes,
         ),
+        eval_data_fn=lambda per_host_bs: synthetic_image_classification(
+            batch_size=per_host_bs,
+            image_size=(image_size, image_size, 3),
+            num_classes=num_classes, holdout=True,
+        ),
         # Pure DP is the reference's ResNet-50 mode (sync allreduce); conv
         # kernels are small relative to activations so replication is right.
         rules=ShardingRules(),

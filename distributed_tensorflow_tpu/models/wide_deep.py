@@ -170,6 +170,10 @@ def make_workload(
             batch_size=per_host_bs, num_dense=num_dense,
             num_sparse=num_sparse, vocab_size=vocab_size,
         ),
+        eval_data_fn=lambda per_host_bs: synthetic_recsys(
+            batch_size=per_host_bs, num_dense=num_dense,
+            num_sparse=num_sparse, vocab_size=vocab_size, holdout=True,
+        ),
         rules=recsys_rules(shard_axis),
         batch_size=batch_size,
         learning_rate=1e-3,

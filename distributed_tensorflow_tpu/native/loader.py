@@ -48,13 +48,17 @@ def _load_library() -> Optional[ctypes.CDLL]:
         try:
             if (not os.path.exists(so_path)
                     or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+                # Per-pid temp name: concurrent processes (multi-worker
+                # launch) must not race g++ writes to one path; os.replace
+                # keeps the install atomic whoever finishes first.
+                tmp = f"{so_path}.tmp.{os.getpid()}"
                 cmd = [
                     "g++", "-O3", "-shared", "-fPIC", "-pthread",
-                    "-std=c++17", _SRC, "-o", so_path + ".tmp",
+                    "-std=c++17", _SRC, "-o", tmp,
                 ]
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=120)
-                os.replace(so_path + ".tmp", so_path)
+                os.replace(tmp, so_path)
             lib = ctypes.CDLL(so_path)
         except (OSError, subprocess.SubprocessError) as e:
             logger.warning("native loader unavailable (%s); using numpy "
@@ -114,9 +118,9 @@ class RecordFile:
         for name, shape, dtype in self.fields:
             nbytes = int(np.prod(shape)) * dtype.itemsize
             chunk = flat[:, offset:offset + nbytes]
-            out[name] = np.ascontiguousarray(chunk).view(dtype).reshape(
-                (B,) + shape
-            )
+            # .copy() is required even when the slice is already contiguous:
+            # the caller's batch must not alias the loader's reused buffer.
+            out[name] = chunk.copy().view(dtype).reshape((B,) + shape)
             offset += nbytes
         return out
 

@@ -32,7 +32,7 @@ def start_profiler_server(port: int = 9012):
 class Profile:
     """``with Profile(logdir):`` traces the enclosed steps into TensorBoard."""
 
-    def __init__(self, log_dir: str, *, host_tracer_level: Optional[int] = None):
+    def __init__(self, log_dir: str):
         self.log_dir = log_dir
 
     def __enter__(self):

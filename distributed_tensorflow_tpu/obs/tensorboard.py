@@ -35,13 +35,19 @@ class TensorBoardHook(Hook):
         os.makedirs(self.log_dir, exist_ok=True)
         self._writer = SummaryWriter(self.log_dir)
 
-    def after_step(self, loop, step, metrics: Optional[Dict[str, float]]):
-        if self._writer is None or metrics is None:
-            return
-        if step % self.every_steps:
+    def write(self, step: int, metrics: Dict[str, float]) -> None:
+        """Unconditional write (EvalHook and other out-of-band callers)."""
+        if self._writer is None:
             return
         for k, v in metrics.items():
             self._writer.add_scalar(f"train/{k}", v, global_step=step)
+
+    def after_step(self, loop, step, metrics: Optional[Dict[str, float]]):
+        # metrics is non-None only at the loop's metrics_every cadence; write
+        # every point it gives us (gating again on every_steps here would
+        # silently drop points whenever the two cadences don't align).
+        if metrics is not None:
+            self.write(step, metrics)
 
     def end(self, loop, step):
         if self._writer is not None:
@@ -63,12 +69,16 @@ class MetricsFileWriter(Hook):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._f = open(self.path, "a")
 
-    def after_step(self, loop, step, metrics):
-        if self._f is None or metrics is None:
+    def write(self, step: int, metrics: Dict[str, float]) -> None:
+        if self._f is None:
             return
         self._f.write(json.dumps(
             {"step": step, "time": time.time(), **metrics}
         ) + "\n")
+
+    def after_step(self, loop, step, metrics):
+        if metrics is not None:
+            self.write(step, metrics)
 
     def end(self, loop, step):
         if self._f is not None:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -33,11 +34,13 @@ from distributed_tensorflow_tpu.training import (
     BF16,
     FP32,
     CheckpointHook,
+    EvalHook,
     LoggingHook,
     NanHook,
     ProfilerHook,
     TrainLoop,
     TrainState,
+    make_eval_step,
     make_train_step,
 )
 
@@ -67,6 +70,8 @@ class TrainArgs:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
     log_every: int = 50
+    eval_every: int = 0  # 0 disables periodic evaluation
+    eval_batches: int = 10
     profile_dir: Optional[str] = None
     tensorboard_dir: Optional[str] = None
     metrics_file: Optional[str] = None
@@ -93,6 +98,9 @@ def parse_args(argv=None) -> TrainArgs:
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=1000)
     p.add_argument("--log_every", type=int, default=50)
+    p.add_argument("--eval_every", type=int, default=0,
+                   help="run evaluation every N steps (0 = off)")
+    p.add_argument("--eval_batches", type=int, default=10)
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--tensorboard_dir", type=str, default=None)
     p.add_argument("--metrics_file", type=str, default=None)
@@ -177,6 +185,12 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     resolver = cluster_lib.resolve(args.job_name, args.task_index)
     server = cluster_lib.Server.from_resolver(resolver)
     if not resolver.is_compute_task():
+        if resolver.task_type == "evaluator" and args.checkpoint_dir:
+            # The reference's evaluator job continuously evaluates new
+            # checkpoints (TF estimator train-and-evaluate contract).
+            result = run_evaluator(args)
+            server.shutdown()
+            return result
         logger.info(
             "task %s:%s is a %s task: parameters are mesh-sharded on TPU; "
             "parking in join() for launcher compatibility",
@@ -255,6 +269,17 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         from distributed_tensorflow_tpu.obs import MetricsFileWriter
 
         hooks.append(MetricsFileWriter(args.metrics_file))
+    if args.eval_every > 0:
+        eval_step = make_eval_step(
+            workload.eval_loss_fn or workload.loss_fn,
+            precision=precision, stateful=workload.stateful,
+        )
+        eval_iter = make_eval_data(workload, batch_shardings)
+        writers = [h for h in hooks if callable(getattr(h, "write", None))]
+        hooks.append(EvalHook(
+            eval_step, eval_iter, every_steps=args.eval_every,
+            num_batches=args.eval_batches, writers=writers,
+        ))
 
     # 6. Loop.
     loop = TrainLoop(
@@ -281,6 +306,85 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     }
     logger.info("done: %s", result)
     return result
+
+
+def make_eval_data(workload, batch_shardings):
+    """Eval input stream: the workload's held-out split (eval_data_fn),
+    sharded like the train batches.  Falls back to the training stream with
+    a warning — eval-on-train cannot measure generalization."""
+    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+
+    fn = workload.eval_data_fn
+    if fn is None:
+        logger.warning(
+            "workload %r has no eval_data_fn; evaluating on the TRAINING "
+            "stream", workload.name,
+        )
+        fn = workload.data_fn
+    host_iter = fn(per_host_batch_size(workload.batch_size))
+    return make_global_batches(host_iter, batch_shardings[workload.example_key])
+
+
+def run_evaluator(args: TrainArgs) -> Dict[str, Any]:
+    """Sidecar evaluator: poll the checkpoint dir, evaluate each new step.
+
+    The reference runs this as the ``evaluator`` job of TF_CONFIG (estimator
+    train_and_evaluate); here it is a read-only process — it restores into
+    its own mesh and never joins the training collectives.
+    """
+    import time as _time
+
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(
+        data=args.data, fsdp=args.fsdp, tensor=args.tensor,
+        pipe=args.pipe, context=args.context, expert=args.expert,
+    ))
+    overrides: Dict[str, Any] = {"mesh": mesh}
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    workload = get_workload(args.model, **overrides)
+    precision = BF16 if args.precision == "bf16" else FP32
+    state, state_shardings, _, batch_shardings = build_state_and_step(
+        workload, mesh, precision=precision, total_steps=max(args.steps, 2),
+    )
+    manager = CheckpointManager(args.checkpoint_dir, save_interval_steps=1)
+    eval_step = make_eval_step(
+        workload.eval_loss_fn or workload.loss_fn,
+        precision=precision, stateful=workload.stateful,
+    )
+    eval_iter = make_eval_data(workload, batch_shardings)
+    rng = jax.random.key(args.seed + 2)
+
+    last_seen = -1
+    results: Dict[str, Any] = {}
+    idle_timeout_s = float(os.environ.get("DTT_EVAL_IDLE_TIMEOUT_S", "600"))
+    last_progress = _time.monotonic()
+    while True:
+        step = manager.latest_step()
+        if _time.monotonic() - last_progress > idle_timeout_s:
+            logger.warning(
+                "evaluator: no new checkpoint in %.0fs (last step %d); "
+                "assuming the trainer is gone and exiting",
+                idle_timeout_s, last_seen,
+            )
+            break
+        if step is not None and step > last_seen:
+            last_progress = _time.monotonic()
+            state = manager.restore(step, template=state)
+            sums: Dict[str, float] = {}
+            for _ in range(args.eval_batches):
+                rng, sub = jax.random.split(rng)
+                m = eval_step(state, next(eval_iter), sub)
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(jax.device_get(v))
+            results = {f"eval_{k}": v / args.eval_batches
+                       for k, v in sums.items()}
+            logger.info("evaluator @ step %d: %s", step, results)
+            last_seen = step
+        if last_seen >= args.steps:
+            break
+        _time.sleep(2.0)
+    manager.close()
+    return {"final_step": last_seen, **results}
 
 
 def main(argv=None):

@@ -2,6 +2,7 @@
 
 from distributed_tensorflow_tpu.training.loop import (
     CheckpointHook,
+    EvalHook,
     Hook,
     LoggingHook,
     NanHook,
@@ -21,6 +22,7 @@ __all__ = [
     "BF16",
     "FP32",
     "CheckpointHook",
+    "EvalHook",
     "Hook",
     "LoggingHook",
     "NanHook",

@@ -120,6 +120,56 @@ class ProfilerHook(Hook):
             self._active = False
 
 
+class EvalHook(Hook):
+    """Periodic in-training evaluation (the reference's evaluator pattern,
+    inlined: TF1 ran a separate evaluator job re-reading checkpoints; with a
+    compiled eval step the cheaper TPU-native form is to evaluate in-loop at
+    an interval).  Averages metrics over ``num_batches`` eval batches.
+    """
+
+    def __init__(self, eval_step: Callable, data_iter: Iterable,
+                 *, every_steps: int, num_batches: int = 10,
+                 rng: Optional[jax.Array] = None,
+                 writers: Optional[List["Hook"]] = None):
+        self.eval_step = eval_step
+        self.data_iter = iter(data_iter)
+        self.every_steps = max(1, every_steps)
+        self.num_batches = num_batches
+        self.rng = rng if rng is not None else jax.random.key(17)
+        self.last_eval_metrics: Dict[str, float] = {}
+        # Metric-writer hooks (TensorBoard/JSONL) to push eval points into —
+        # they only see per-step metrics otherwise.
+        self.writers = writers or []
+
+    def _evaluate(self, loop, step):
+        sums: Dict[str, float] = {}
+        for _ in range(self.num_batches):
+            batch = next(self.data_iter)
+            self.rng, sub = jax.random.split(self.rng)
+            m = self.eval_step(loop.state, batch, sub)
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(np.asarray(jax.device_get(v)))
+        self.last_eval_metrics = {
+            f"eval_{k}": v / self.num_batches for k, v in sums.items()
+        }
+        loop.last_logged_metrics.update(self.last_eval_metrics)
+        msg = ", ".join(f"{k}={v:.4g}"
+                        for k, v in sorted(self.last_eval_metrics.items()))
+        logger.info("eval @ step %d: %s", step, msg)
+        for w in self.writers:
+            write = getattr(w, "write", None)
+            if callable(write):
+                write(step, self.last_eval_metrics)
+
+    def after_step(self, loop, step, metrics):
+        if step % self.every_steps == 0 and step > 0:
+            self._evaluate(loop, step)
+
+    def end(self, loop, step):
+        if step > 0 and step % self.every_steps != 0:
+            self._evaluate(loop, step)
+
+
 class TrainLoop:
     """Drives (state, batch) -> state for a fixed number of steps.
 

@@ -113,6 +113,26 @@ class TestLoader:
         np.testing.assert_array_equal(b["label"], np.arange(8))
         np.testing.assert_allclose(b["image"], arrays["image"][:8])
 
+    def test_single_field_batches_do_not_alias(self, tmp_path):
+        # regression: single-field records must not alias the loader's
+        # reused output buffer across __next__ calls
+        rec = RecordFile([("tokens", (8,), np.int32)])
+        n = 32
+        arrays = {"tokens": np.arange(n * 8, dtype=np.int32).reshape(n, 8)}
+        path = str(tmp_path / "tok.rec")
+        rec.write(path, arrays)
+        loader = NativeRecordLoader(
+            path, rec, batch_size=4, shuffle=False,
+            shard_index=0, shard_count=1, num_threads=1,
+        )
+        b1 = next(loader)["tokens"].copy()
+        held = next(loader)["tokens"]  # hold WITHOUT copying
+        next(loader)
+        np.testing.assert_array_equal(
+            held, np.arange(32, 64, dtype=np.int32).reshape(4, 8)
+        )
+        loader.close()
+
     def test_missing_file_raises(self, record, tmp_path):
         with pytest.raises(FileNotFoundError):
             NativeRecordLoader(

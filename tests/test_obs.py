@@ -41,6 +41,24 @@ class TestMetricsFileWriter:
         assert steps == sorted(steps)
 
 
+class TestEvalReachesWriters:
+    def test_eval_points_written_to_jsonl_and_tb(self, tmp_path):
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        tb = str(tmp_path / "tb")
+        jl = str(tmp_path / "m.jsonl")
+        run(TrainArgs(
+            model="mnist", steps=20, batch_size=32, log_every=10,
+            eval_every=10, eval_batches=2,
+            tensorboard_dir=tb, metrics_file=jl,
+        ))
+        lines = [json.loads(l) for l in open(jl)]
+        eval_lines = [l for l in lines if any(k.startswith("eval_")
+                                             for k in l)]
+        assert eval_lines, "no eval metrics in JSONL"
+        assert os.listdir(tb)
+
+
 class TestProfile:
     def test_trace_context_manager(self, tmp_path):
         import jax

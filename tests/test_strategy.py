@@ -1,0 +1,122 @@
+"""Strategy API tests: the tf.distribute-shaped surface must behave like the
+reference's (scope nesting, run/reduce semantics, dataset distribution,
+coordinator schedule/join/fetch with retry).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.distribute import (
+    ClusterCoordinator,
+    MirroredStrategy,
+    MultiWorkerMirroredStrategy,
+    OneDeviceStrategy,
+    ParameterServerStrategy,
+    TPUStrategy,
+    get_strategy,
+)
+from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
+
+
+class TestStrategySurface:
+    def test_scope_sets_current(self):
+        s = MirroredStrategy()
+        assert get_strategy() is None
+        with s.scope():
+            assert get_strategy() is s
+            with OneDeviceStrategy().scope() as inner:
+                assert get_strategy() is inner
+            assert get_strategy() is s
+        assert get_strategy() is None
+
+    def test_num_replicas(self):
+        assert MirroredStrategy().num_replicas_in_sync == 8
+        assert OneDeviceStrategy().num_replicas_in_sync == 1
+
+    def test_run_executes_global_program(self):
+        s = MultiWorkerMirroredStrategy()
+        x = np.arange(16, dtype=np.float32)
+        out = s.run(lambda a: a * 2, (x,))
+        np.testing.assert_allclose(np.asarray(out), x * 2)
+
+    def test_reduce_mean_sum(self):
+        s = TPUStrategy()
+        v = jnp.arange(8, dtype=jnp.float32)
+        assert float(s.reduce("MEAN", v)) == pytest.approx(3.5)
+        assert float(s.reduce("SUM", v)) == pytest.approx(28.0)
+        with pytest.raises(ValueError):
+            s.reduce("MAX", v)
+
+    def test_distribute_dataset_shards_batches(self):
+        s = MirroredStrategy()
+
+        def host_iter():
+            while True:
+                yield {"x": np.ones((16, 4), np.float32)}
+
+        it = iter(s.experimental_distribute_dataset(host_iter()))
+        batch = next(it)
+        assert batch["x"].shape == (16, 4)
+        assert not batch["x"].sharding.is_fully_replicated
+
+    def test_place_with_rules(self):
+        s = TPUStrategy()
+        tree = {"emb": jnp.zeros((16, 4)), "b": jnp.zeros((3,))}
+        placed = s.place(tree, ShardingRules([(r"emb", P("data"))]))
+        assert not placed["emb"].sharding.is_fully_replicated
+        assert placed["b"].sharding.is_fully_replicated
+
+    def test_ps_strategy_shards_large_vars(self):
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8), jax.devices())
+        s = ParameterServerStrategy(mesh=mesh)
+        tree = {
+            "table": jnp.zeros((1024, 64)),  # big: sharded
+            "bias": jnp.zeros((4,)),  # small: replicated
+        }
+        placed = s.place(tree)
+        assert not placed["table"].sharding.is_fully_replicated
+        assert placed["bias"].sharding.is_fully_replicated
+
+
+class TestClusterCoordinator:
+    def test_schedule_join_fetch(self):
+        coord = ClusterCoordinator()
+        vals = [coord.schedule(lambda i=i: i * i) for i in range(10)]
+        coord.join()
+        assert coord.done()
+        assert [coord.fetch(v) for v in vals] == [i * i for i in range(10)]
+        coord.shutdown()
+
+    def test_retry_then_success(self):
+        coord = ClusterCoordinator(max_retries=2)
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        rv = coord.schedule(flaky)
+        coord.join()
+        assert rv.fetch() == "ok"
+        assert len(attempts) == 3
+        coord.shutdown()
+
+    def test_exhausted_retries_surface_in_join(self):
+        coord = ClusterCoordinator(max_retries=1)
+
+        def always_fails():
+            raise ValueError("permanent")
+
+        rv = coord.schedule(always_fails)
+        with pytest.raises(ValueError, match="permanent"):
+            coord.join()
+        with pytest.raises(ValueError):
+            rv.fetch()
+        coord.shutdown()

@@ -103,6 +103,38 @@ class TestTrainStep:
         assert state.params["w"].dtype == jnp.float32
 
 
+class TestEval:
+    def test_periodic_eval_in_training(self):
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        result = run(TrainArgs(
+            model="mnist", steps=20, batch_size=32, log_every=10,
+            eval_every=10, eval_batches=2,
+        ))
+        assert result["final_step"] == 20
+        assert "eval_loss" in result
+        assert np.isfinite(result["eval_loss"])
+
+    def test_evaluator_role_consumes_checkpoints(self, tmp_path):
+        from distributed_tensorflow_tpu.train_lib import (
+            TrainArgs,
+            run,
+            run_evaluator,
+        )
+
+        ckpt = str(tmp_path / "ckpt")
+        run(TrainArgs(
+            model="mnist", steps=10, batch_size=32, log_every=5,
+            checkpoint_dir=ckpt, checkpoint_every=5,
+        ))
+        result = run_evaluator(TrainArgs(
+            model="mnist", steps=10, batch_size=32, checkpoint_dir=ckpt,
+            eval_batches=2,
+        ))
+        assert result["final_step"] == 10
+        assert "eval_loss" in result and np.isfinite(result["eval_loss"])
+
+
 class TestTrainLoop:
     def test_loop_runs_hooks_and_counts_steps(self, caplog):
         state = make_linear_state()

@@ -19,7 +19,10 @@ from distributed_tensorflow_tpu.cluster.coordination import (
 )
 from distributed_tensorflow_tpu.cluster.resolver import (
     ClusterResolver,
+    GCEClusterResolver,
+    KubernetesClusterResolver,
     SimpleClusterResolver,
+    SlurmClusterResolver,
     TFConfigClusterResolver,
     TPUClusterResolver,
     resolve,
@@ -42,7 +45,10 @@ __all__ = [
     "ClusterDeviceFilters",
     "ClusterSpec",
     "ClusterResolver",
+    "GCEClusterResolver",
+    "KubernetesClusterResolver",
     "SimpleClusterResolver",
+    "SlurmClusterResolver",
     "TFConfigClusterResolver",
     "TPUClusterResolver",
     "resolve",

@@ -128,6 +128,107 @@ class TFConfigClusterResolver(ClusterResolver):
         return self._load().get("environment", "")
 
 
+class SlurmClusterResolver(ClusterResolver):
+    """Topology from Slurm environment variables.
+
+    (TF analog: cluster_resolver/slurm_cluster_resolver.py.)  Reads
+    SLURM_PROCID / SLURM_NTASKS / SLURM_STEP_NODELIST-style variables; every
+    task is a ``worker`` (TPU-native has no ps job to assign).
+    """
+
+    def __init__(self, port: int = 8888, environ: Optional[dict] = None):
+        env = environ if environ is not None else os.environ
+        self._port = port
+        self._ntasks = int(env.get("SLURM_NTASKS", "1"))
+        self.task_type = "worker"
+        self.task_id = int(env.get("SLURM_PROCID", "0"))
+        nodelist = env.get("SLURM_STEP_NODELIST") or env.get("SLURM_NODELIST", "")
+        self._hosts = _expand_slurm_nodelist(nodelist) or ["localhost"]
+
+    def cluster_spec(self) -> ClusterSpec:
+        # one task per node by default; multi-task nodes get distinct ports.
+        # ceil division: every launched task must get an address (floor
+        # would drop tasks when ntasks % nodes != 0).
+        n_hosts = max(1, len(self._hosts))
+        tasks_per_node = max(1, -(-self._ntasks // n_hosts))
+        addrs = [
+            f"{h}:{self._port + i}"
+            for h in self._hosts
+            for i in range(tasks_per_node)
+        ][: self._ntasks]
+        return ClusterSpec({"worker": addrs})
+
+
+def _expand_slurm_nodelist(nodelist: str) -> list:
+    """Expand 'host[1-3,7],other' to [host1, host2, host3, host7, other].
+
+    Handles the single-level bracket ranges Slurm emits; exotic nested forms
+    should use ``scontrol show hostnames`` upstream and pass TF_CONFIG.
+    """
+    import re
+
+    if not nodelist:
+        return []
+    hosts = []
+    for part in re.findall(r"[^,\[\]]+(?:\[[^\]]*\])?", nodelist):
+        m = re.match(r"^(.*)\[([^\]]*)\]$", part)
+        if not m:
+            if part.strip():
+                hosts.append(part.strip())
+            continue
+        prefix, ranges = m.groups()
+        for r in ranges.split(","):
+            if "-" in r:
+                lo, hi = r.split("-")
+                width = len(lo)
+                for i in range(int(lo), int(hi) + 1):
+                    hosts.append(f"{prefix}{str(i).zfill(width)}")
+            elif r:
+                hosts.append(f"{prefix}{r}")
+    return hosts
+
+
+class KubernetesClusterResolver(ClusterResolver):
+    """Topology from the downward-API env a K8s job template exposes.
+
+    (TF analog: cluster_resolver/kubernetes_cluster_resolver.py, which lists
+    pods via the API server; zero-egress TPU pods instead inject
+    DTT_K8S_WORKER_HOSTS + DTT_K8S_POD_INDEX, the jobset/indexed-job
+    pattern.)
+    """
+
+    def __init__(self, environ: Optional[dict] = None):
+        env = environ if environ is not None else os.environ
+        hosts = env.get("DTT_K8S_WORKER_HOSTS", "")
+        self._hosts = [h.strip() for h in hosts.split(",") if h.strip()]
+        self.task_type = "worker"
+        self.task_id = int(env.get("DTT_K8S_POD_INDEX",
+                                   env.get("JOB_COMPLETION_INDEX", "0")))
+
+    def cluster_spec(self) -> ClusterSpec:
+        return ClusterSpec({"worker": self._hosts} if self._hosts else {})
+
+
+class GCEClusterResolver(ClusterResolver):
+    """Fixed-instance-group topology (TF analog: gce_cluster_resolver.py).
+
+    Without metadata-server egress, instances are named by the launcher:
+    DTT_GCE_INSTANCES="inst-0:8888,inst-1:8888" DTT_GCE_INDEX=0.
+    """
+
+    def __init__(self, environ: Optional[dict] = None):
+        env = environ if environ is not None else os.environ
+        self._addrs = [
+            a.strip() for a in env.get("DTT_GCE_INSTANCES", "").split(",")
+            if a.strip()
+        ]
+        self.task_type = "worker"
+        self.task_id = int(env.get("DTT_GCE_INDEX", "0"))
+
+    def cluster_spec(self) -> ClusterSpec:
+        return ClusterSpec({"worker": self._addrs} if self._addrs else {})
+
+
 class TPUClusterResolver(ClusterResolver):
     """Resolves the local TPU slice topology.
 
@@ -159,11 +260,29 @@ def resolve(
 ) -> ClusterResolver:
     """One-stop resolution implementing the reference launcher contract.
 
-    Priority: explicit ClusterSpec > TF_CONFIG env > single-process.
-    ``--job_name/--task_index`` flags override the task identity either way
-    (the TF1 PS-launcher contract, SURVEY.md §4.2).
+    Priority: explicit ClusterSpec > TF_CONFIG env > Slurm env > K8s env >
+    GCE env > single-process.  ``--job_name/--task_index`` flags override
+    the task identity either way (the TF1 PS-launcher contract, SURVEY.md
+    §4.2).
     """
     if cluster_spec is not None:
         return SimpleClusterResolver(cluster_spec, job_name, task_index)
-    resolver = TFConfigClusterResolver(task_type=job_name, task_id=task_index)
-    return resolver
+    if os.environ.get("TF_CONFIG"):
+        return TFConfigClusterResolver(task_type=job_name, task_id=task_index)
+    resolver: Optional[ClusterResolver] = None
+    if os.environ.get("SLURM_PROCID") and int(
+        os.environ.get("SLURM_NTASKS", "1")
+    ) > 1:
+        resolver = SlurmClusterResolver()
+    elif os.environ.get("DTT_K8S_WORKER_HOSTS"):
+        resolver = KubernetesClusterResolver()
+    elif os.environ.get("DTT_GCE_INSTANCES"):
+        resolver = GCEClusterResolver()
+    if resolver is not None:
+        # the launcher-flag contract overrides discovered task identity
+        if job_name is not None:
+            resolver.task_type = job_name
+        if task_index is not None:
+            resolver.task_id = task_index
+        return resolver
+    return TFConfigClusterResolver(task_type=job_name, task_id=task_index)

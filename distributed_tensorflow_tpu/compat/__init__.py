@@ -1,0 +1,26 @@
+"""API-compatibility shims for code written against the reference's TF idioms.
+
+Everything here is a thin adapter onto the one TPU-native mechanism; each
+class documents what of the original's behavior is preserved, subsumed, or
+meaningless on TPU.  Nothing in the hot path lives here.
+"""
+
+from distributed_tensorflow_tpu.compat.v1 import (
+    CrossDeviceOps,
+    HierarchicalCopyAllReduce,
+    MonitoredTrainingSession,
+    NcclAllReduce,
+    ReductionToOneDevice,
+    SyncReplicasOptimizer,
+    replica_device_setter,
+)
+
+__all__ = [
+    "CrossDeviceOps",
+    "HierarchicalCopyAllReduce",
+    "MonitoredTrainingSession",
+    "NcclAllReduce",
+    "ReductionToOneDevice",
+    "SyncReplicasOptimizer",
+    "replica_device_setter",
+]

@@ -1,0 +1,189 @@
+"""TF1-style API shims (the reference's between-graph idioms).
+
+Each shim preserves the *call shape* of the original so the reference's
+train.py code paths port mechanically, while the behavior maps onto the
+TPU-native engine (or is documented as subsumed by it).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import optax
+
+logger = logging.getLogger(__name__)
+PyTree = Any
+
+
+# -- device placement (SURVEY.md §4.2) ---------------------------------------
+
+def replica_device_setter(
+    ps_tasks: int = 0,
+    ps_device: str = "/job:ps",
+    worker_device: str = "/job:worker",
+    cluster=None,
+    ps_strategy=None,
+):
+    """$TF/python/training/device_setter.py:129 call-shape shim.
+
+    The original returned a device-chooser fn placing each variable on a ps
+    task round-robin; every later read/write crossed worker↔ps as gRPC
+    RecvTensor.  On TPU variables are mesh-resident (sharded or replicated)
+    — there is nothing to place, so this returns a no-op device function and
+    logs the translation.  Use ``parallel.sharding.ShardingRules`` /
+    ``fsdp_sharding`` for the actual residency policy (the PS replacement).
+    """
+    logger.info(
+        "replica_device_setter(ps_tasks=%s): PS placement is subsumed by "
+        "mesh sharding on TPU; returning no-op device function", ps_tasks,
+    )
+
+    def _device_fn(op=None):
+        return ""
+
+    return _device_fn
+
+
+# -- SyncReplicasOptimizer (SURVEY.md §3.1, BERT path) ------------------------
+
+class SyncReplicasOptimizer:
+    """$TF/python/training/sync_replicas_optimizer.py:42 semantic shim.
+
+    The original turned async PS training into sync training: workers push
+    gradients to shared accumulators, the chief applies the average once
+    ``replicas_to_aggregate`` arrived, stale gradients are dropped.  Under
+    sync SPMD every step already aggregates every replica exactly once — the
+    mechanism is the XLA AllReduce, there are no stragglers to gate and no
+    staleness to drop.  What meaningfully survives is *gradient
+    accumulation*: aggregating ``replicas_to_aggregate`` microbatch
+    gradients before one optimizer step, which this shim implements over
+    optax (``optax.MultiSteps``).
+    """
+
+    def __init__(
+        self,
+        opt: optax.GradientTransformation,
+        replicas_to_aggregate: int,
+        total_num_replicas: Optional[int] = None,
+        **_unused,
+    ):
+        self.replicas_to_aggregate = replicas_to_aggregate
+        self._tx = optax.MultiSteps(opt, every_k_schedule=replicas_to_aggregate)
+
+    def as_gradient_transformation(self) -> optax.GradientTransformation:
+        """The optax transformation to hand to TrainState.create."""
+        return self._tx
+
+    # TF1 surface
+    def apply_gradients(self, grads_and_vars, global_step=None):
+        raise NotImplementedError(
+            "graph-mode apply_gradients has no TPU-native meaning; use "
+            "as_gradient_transformation() with the training step "
+            "(make_train_step), which applies the sync aggregation inside "
+            "the compiled program"
+        )
+
+    def make_session_run_hook(self, is_chief: bool, num_tokens: int = -1):
+        """The original's queue-runner hook is unnecessary (no queues)."""
+        from distributed_tensorflow_tpu.training.loop import Hook
+
+        return Hook()
+
+
+# -- CrossDeviceOps hierarchy (SURVEY.md §3.2) --------------------------------
+
+class CrossDeviceOps:
+    """$TF/python/distribute/cross_device_ops.py:252 shim.
+
+    The reference let users pick a gradient-reduction algorithm (NCCL ring,
+    hierarchical copy, reduce-to-one-device).  On TPU the algorithm is
+    chosen by XLA for the ICI topology; these classes exist so configs that
+    name one keep working, and ``reduce`` offers the same call shape backed
+    by ``parallel.collectives``.
+    """
+
+    algorithm = "xla-default"
+
+    def reduce(self, reduce_op: str, value, axis: int = 0):
+        """Elementwise cross-replica reduction, shape-preserving.
+
+        TF semantics: a PerReplica value is N same-shaped tensors; reduce
+        returns one tensor of that shape.  The equivalent container here is
+        a leading replica dim — ``axis`` names it — which is reduced away,
+        preserving the per-replica shape.  (Gradients produced inside a
+        jitted sharded step are already globally reduced by XLA; this shim
+        is for host-side PerReplica-style values.)
+        """
+        import jax.numpy as jnp
+
+        op = reduce_op.lower()
+        if op not in ("mean", "sum"):
+            raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+        fn = jnp.mean if op == "mean" else jnp.sum
+
+        def _one(x):
+            x = jnp.asarray(x)
+            return fn(x, axis=axis) if x.ndim > 0 else x
+
+        return jax.tree.map(_one, value)
+
+    def batch_reduce(self, reduce_op: str, value_axis_pairs):
+        return [self.reduce(reduce_op, v, a) for v, a in value_axis_pairs]
+
+
+class NcclAllReduce(CrossDeviceOps):
+    """cross_device_ops.py:960 — named for config compat; NCCL does not
+    exist on TPU (north star: 'no CUDA/NCCL in the build'); reductions are
+    XLA AllReduce over ICI regardless."""
+
+    algorithm = "nccl->ici-allreduce"
+
+    def __init__(self, num_packs: int = 1):
+        if num_packs != 1:
+            logger.info("num_packs=%d ignored: XLA's all-reduce combiner "
+                        "performs gradient packing", num_packs)
+
+
+class HierarchicalCopyAllReduce(CrossDeviceOps):
+    """cross_device_ops.py:997 — hierarchy is the ICI torus's job now."""
+
+    algorithm = "hierarchical->ici-allreduce"
+
+    def __init__(self, num_packs: int = 1):
+        pass
+
+
+class ReductionToOneDevice(CrossDeviceOps):
+    """cross_device_ops.py:582 — gather-to-one-device then redistribute."""
+
+    algorithm = "reduce-to-one-device"
+
+
+# -- MonitoredTrainingSession (SURVEY.md §4.2) --------------------------------
+
+def MonitoredTrainingSession(
+    master: str = "",
+    is_chief: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    hooks: Sequence[Any] = (),
+    save_checkpoint_steps: int = 1000,
+    **_unused,
+):
+    """$TF/python/training/monitored_session.py:428 call-shape shim.
+
+    Returns a factory mapping onto ``training.TrainLoop``: there is no
+    session to run ops in, so the shim returns the pieces the TF1 pattern
+    supplied implicitly — a CheckpointManager rooted at ``checkpoint_dir``
+    (created only on the chief, mirroring the original's chief-only saving)
+    and the hook list to extend.  See train_lib.run for the full loop.
+    """
+    manager = None
+    if checkpoint_dir and is_chief:
+        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(
+            checkpoint_dir, save_interval_steps=save_checkpoint_steps
+        )
+    return manager, list(hooks)

@@ -110,7 +110,21 @@ class ClusterCoordinator:
         while True:
             item = self._queue.get()
             if item is None:
-                return
+                # shutdown: fail anything still queued (including closures
+                # re-queued for retry behind the sentinel) so join()/fetch()
+                # cannot hang on a silently-dropped item.
+                while True:
+                    try:
+                        leftover = self._queue.get_nowait()
+                    except queue.Empty:
+                        return
+                    if leftover is None:
+                        continue
+                    _, _, _, rv, _ = leftover
+                    rv._set_error(RuntimeError("coordinator shut down"))
+                    with self._lock:
+                        self._pending -= 1
+                        self._lock.notify_all()
             fn, args, kwargs, rv, attempt = item
             try:
                 result = fn(*args, **kwargs)

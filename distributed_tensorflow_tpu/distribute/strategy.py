@@ -53,6 +53,9 @@ class Strategy:
     def __init__(self, mesh: Optional[Mesh] = None):
         self._mesh = mesh if mesh is not None else build_mesh(MeshConfig())
         self._rules = ShardingRules()
+        # per-fn jit cache: run() is the per-step API; a fresh jax.jit each
+        # call would retrace every step
+        self._jitted: dict = {}
 
     # -- core tf.distribute surface ------------------------------------------
     @contextlib.contextmanager
@@ -94,7 +97,10 @@ class Strategy:
 
         args = jax.tree.map(_place, args)
         kwargs = jax.tree.map(_place, kwargs)
-        return jax.jit(fn)(*args, **kwargs)
+        jitted = self._jitted.get(fn)
+        if jitted is None:
+            jitted = self._jitted.setdefault(fn, jax.jit(fn))
+        return jitted(*args, **kwargs)
 
     def reduce(self, reduce_op: str, value: PyTree, axis: Optional[int] = 0):
         """MEAN/SUM reduction of a (batch-sharded) value to a scalar/host

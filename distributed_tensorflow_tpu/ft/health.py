@@ -34,8 +34,11 @@ def make_default_probe(interval_s: float = 30.0):
     probing on the same cadence agree on the id without any shared counter,
     and — unlike a per-process counter — the id re-synchronizes by itself
     after a host restarts or starts late (a counter desyncs permanently).
-    An occasional quantum-boundary mismatch shows up as one failed probe;
-    ``failures_before_action >= 2`` absorbs it.
+    This works because ``HealthChecker._run`` aligns probe times to quantum
+    boundaries (all hosts fire at boundary+epsilon), and the id rounds to
+    the NEAREST boundary, so clock skew up to quantum/2 cannot produce
+    different ids.  Residual mismatches (extreme skew, scheduling stalls)
+    show up as failed probes absorbed by ``failures_before_action >= 2``.
     Single-process: trivially healthy.
     """
     quantum = max(interval_s, 1.0)
@@ -43,7 +46,9 @@ def make_default_probe(interval_s: float = 30.0):
     def probe(timeout_s: float) -> bool:
         if jax.process_count() <= 1:
             return True
-        rid = int(time.time() // quantum)
+        # nearest boundary: probes fire at boundary+eps, so round-to-nearest
+        # tolerates skew/jitter of +-quantum/2 (vs floor's zero tolerance)
+        rid = int((time.time() + quantum / 2) // quantum)
         try:
             client = jax._src.distributed.global_state.client
             if client is None:
@@ -101,8 +106,15 @@ class HealthChecker:
             self._thread.join(timeout=self.timeout_s + 1)
             self._thread = None
 
+    def _wait_next_probe(self) -> bool:
+        """Sleep until the next interval boundary (wall-clock aligned, so
+        every host's probes fire at the same phase — see make_default_probe).
+        Returns True if stop was requested."""
+        delay = self.interval_s - (time.time() % self.interval_s)
+        return self._stop.wait(delay)
+
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not self._wait_next_probe():
             healthy = False
             try:
                 healthy = self._probe(self.timeout_s)

@@ -32,6 +32,7 @@ from flax import linen as nn
 from jax.sharding import Mesh
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_lm
+from distributed_tensorflow_tpu.ops import flash_attention
 from distributed_tensorflow_tpu.parallel.ring_attention import ring_attention
 from distributed_tensorflow_tpu.models import Workload
 from distributed_tensorflow_tpu.parallel.sharding import (
@@ -50,6 +51,16 @@ class GPT2Config:
     n_head: int = 16
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
+    # Stack the transformer body as ONE scanned layer (lax.scan over stacked
+    # params): O(1) compile time in depth, the canonical TPU structure.
+    scan_layers: bool = True
+    # Rematerialize each block in backward (jax.checkpoint): trades ~30%
+    # more FLOPs for activation memory ~ O(sqrt) — the TPU-native answer to
+    # the reference's gradient-accumulation-for-memory config.
+    remat: bool = True
+    # Pallas fused attention (ops.flash_attention).  Disables attention-prob
+    # dropout (the prob matrix never materializes); residual dropout stays.
+    use_flash_attention: bool = False
 
     @classmethod
     def small(cls, **kw):
@@ -68,13 +79,15 @@ class GPT2Config:
 class Block(nn.Module):
     cfg: GPT2Config
     mesh: Optional[Mesh] = None
+    deterministic: bool = True  # attribute (not call arg) so nn.scan can map
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool):
+    def __call__(self, x, _=None):
         cfg = self.cfg
+        deterministic = self.deterministic
         d, h = cfg.d_model, cfg.n_head
         head_dim = d // h
-        B, T, _ = x.shape
+        B, T, _unused = x.shape
 
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="c_attn")(y)
@@ -90,6 +103,8 @@ class Block(nn.Module):
             ctx = ring_attention(
                 q, k, v, mesh=self.mesh, causal=True
             ).reshape(B, T, d)
+        elif cfg.use_flash_attention:
+            ctx = flash_attention(q, k, v, causal=True).reshape(B, T, d)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
             mask = jnp.tril(jnp.ones((T, T), bool))
@@ -107,7 +122,7 @@ class Block(nn.Module):
         mlp = nn.gelu(mlp, approximate=True)
         mlp = nn.Dense(d, dtype=cfg.dtype, name="mlp_c_proj")(mlp)
         mlp = nn.Dropout(cfg.dropout, deterministic=deterministic)(mlp)
-        return x + mlp
+        return x + mlp, None
 
 
 class GPT2(nn.Module):
@@ -132,10 +147,24 @@ class GPT2(nn.Module):
         )
         x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
-        for i in range(cfg.n_layer):
-            x = Block(cfg, mesh=self.mesh, name=f"h_{i}")(
-                x, deterministic=deterministic
+        if cfg.scan_layers:
+            body = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            Scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
             )
+            x, _ = Scanned(
+                cfg, mesh=self.mesh, deterministic=deterministic,
+                name="blocks",
+            )(x)
+        else:
+            for i in range(cfg.n_layer):
+                x, _ = Block(
+                    cfg, mesh=self.mesh, deterministic=deterministic,
+                    name=f"h_{i}",
+                )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Weight-tied head; logits in f32 for a stable softmax.
         logits = jnp.einsum(
@@ -163,9 +192,20 @@ def _loss_fn(module: nn.Module, deterministic: bool, params,
 
 
 def gpt2_rules() -> ShardingRules:
-    """TP/fsdp rules for this module's parameter names."""
+    """TP/fsdp rules for this module's parameter names.
+
+    Scanned layout ("blocks/...") parameters carry a leading layer dim —
+    their specs lead with None so the TP/fsdp split lands on the same
+    logical dims as the per-layer ("h_i/...") layout.
+    """
     return transformer_rules().extended(
         [
+            # scanned-stack layout (leading layer dim)
+            (r"blocks/.*c_attn/kernel", P(None, "fsdp", "tensor")),
+            (r"blocks/.*c_proj/kernel", P(None, "tensor", "fsdp")),
+            (r"blocks/.*mlp_c_fc/kernel", P(None, "fsdp", "tensor")),
+            (r"blocks/.*(bias|scale)", P()),
+            # shared / per-layer layout
             (r"wte$", P("tensor", "fsdp")),
             (r"wpe$", P()),
             (r"mlp_c_fc/kernel", P("fsdp", "tensor")),
@@ -182,9 +222,12 @@ def make_workload(
     grad_accum_steps: int = 4,
     config: Optional[GPT2Config] = None,
     mesh: Optional[Mesh] = None,
+    use_flash_attention: Optional[bool] = None,
     **_unused,
 ) -> Workload:
     cfg = config or getattr(GPT2Config, preset)()
+    if use_flash_attention is not None:
+        cfg = dataclasses.replace(cfg, use_flash_attention=use_flash_attention)
     seq = seq_len or min(cfg.n_positions, 1024)
     module = GPT2(cfg, mesh=mesh)
     # Init batch must divide over the batch-sharding axes (ring attention is

@@ -40,7 +40,13 @@ class TensorBoardHook(Hook):
         if self._writer is None:
             return
         for k, v in metrics.items():
-            self._writer.add_scalar(f"train/{k}", v, global_step=step)
+            # eval_* metrics get their own TensorBoard namespace so eval
+            # curves don't render inside the train/ group
+            if k.startswith("eval_"):
+                tag = f"eval/{k[len('eval_'):]}"
+            else:
+                tag = f"train/{k}"
+            self._writer.add_scalar(tag, v, global_step=step)
 
     def after_step(self, loop, step, metrics: Optional[Dict[str, float]]):
         # metrics is non-None only at the loop's metrics_every cadence; write

@@ -1,0 +1,109 @@
+"""Tests: environment cluster resolvers + TF1 API-compatibility shims."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_tpu.cluster import (
+    GCEClusterResolver,
+    KubernetesClusterResolver,
+    SlurmClusterResolver,
+    resolve,
+)
+from distributed_tensorflow_tpu.cluster.resolver import _expand_slurm_nodelist
+from distributed_tensorflow_tpu.compat import (
+    NcclAllReduce,
+    SyncReplicasOptimizer,
+    replica_device_setter,
+)
+
+
+class TestSlurmResolver:
+    def test_nodelist_expansion(self):
+        assert _expand_slurm_nodelist("node[1-3]") == ["node1", "node2", "node3"]
+        assert _expand_slurm_nodelist("n[01-03,07]") == [
+            "n01", "n02", "n03", "n07",
+        ]
+        assert _expand_slurm_nodelist("a,b[2],c") == ["a", "b2", "c"]
+        assert _expand_slurm_nodelist("") == []
+
+    def test_cluster_spec_from_env(self):
+        env = {"SLURM_PROCID": "1", "SLURM_NTASKS": "4",
+               "SLURM_NODELIST": "tpu[0-3]"}
+        r = SlurmClusterResolver(environ=env)
+        spec = r.cluster_spec()
+        assert r.task_id == 1 and r.task_type == "worker"
+        assert spec.num_processes() == 4
+        assert "tpu0:8888" in spec.job_tasks("worker")[0]
+
+    def test_resolve_priority(self, monkeypatch):
+        monkeypatch.delenv("TF_CONFIG", raising=False)
+        monkeypatch.setenv("SLURM_PROCID", "0")
+        monkeypatch.setenv("SLURM_NTASKS", "2")
+        monkeypatch.setenv("SLURM_NODELIST", "h[0-1]")
+        assert isinstance(resolve(), SlurmClusterResolver)
+        # TF_CONFIG wins over Slurm
+        monkeypatch.setenv(
+            "TF_CONFIG",
+            '{"cluster": {"worker": ["a:1"]}, '
+            '"task": {"type": "worker", "index": 0}}',
+        )
+        from distributed_tensorflow_tpu.cluster import TFConfigClusterResolver
+
+        assert isinstance(resolve(), TFConfigClusterResolver)
+
+
+class TestK8sGceResolvers:
+    def test_k8s(self):
+        r = KubernetesClusterResolver(environ={
+            "DTT_K8S_WORKER_HOSTS": "pod-0:9000, pod-1:9000",
+            "DTT_K8S_POD_INDEX": "1",
+        })
+        assert r.task_id == 1
+        assert r.cluster_spec().job_tasks("worker") == [
+            "pod-0:9000", "pod-1:9000",
+        ]
+
+    def test_gce(self):
+        r = GCEClusterResolver(environ={
+            "DTT_GCE_INSTANCES": "inst-0:8888,inst-1:8888",
+            "DTT_GCE_INDEX": "0",
+        })
+        assert r.cluster_spec().num_processes() == 2
+
+
+class TestSyncReplicasOptimizer:
+    def test_aggregates_k_microbatch_grads(self):
+        # k updates with SyncReplicas(k) == 1 update with mean of k grads
+        k = 4
+        sync = SyncReplicasOptimizer(optax.sgd(0.1), replicas_to_aggregate=k)
+        tx = sync.as_gradient_transformation()
+        params = {"w": jnp.ones((3,))}
+        state = tx.init(params)
+        grads = [{"w": jnp.full((3,), float(i + 1))} for i in range(k)]
+        p = params
+        for g in grads:
+            updates, state = tx.update(g, state, p)
+            p = optax.apply_updates(p, updates)
+        expected = 1.0 - 0.1 * np.mean([1, 2, 3, 4])
+        np.testing.assert_allclose(np.asarray(p["w"]), expected, rtol=1e-6)
+
+    def test_graph_mode_api_raises(self):
+        sync = SyncReplicasOptimizer(optax.sgd(0.1), 2)
+        with pytest.raises(NotImplementedError):
+            sync.apply_gradients([])
+
+
+class TestDeviceSetterAndCrossDeviceOps:
+    def test_replica_device_setter_noop(self):
+        fn = replica_device_setter(ps_tasks=3)
+        assert fn() == ""
+
+    def test_nccl_allreduce_reduces(self):
+        ops = NcclAllReduce(num_packs=2)
+        out = ops.reduce("MEAN", jnp.arange(4.0))
+        assert float(out) == pytest.approx(1.5)
+        assert "ici" in ops.algorithm

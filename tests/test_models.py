@@ -113,10 +113,26 @@ class TestGPT2:
     def test_tensor_parallel_sharding_applied(self, mesh_2d):
         wl = self._tiny()
         state, hist = run_steps(wl, mesh_2d, 2)
-        # qkv kernel must actually be sharded over the tensor axis
-        qkv = state.params["h_0"]["c_attn"]["kernel"]
+        # scanned layout: stacked qkv kernel (L, d, 3d); layer dim
+        # unsharded, tensor axis on the output dim
+        qkv = state.params["blocks"]["c_attn"]["kernel"]
+        assert qkv.ndim == 3
         spec = qkv.sharding.spec
         assert "tensor" in tuple(x for x in spec if x), spec
+        assert spec[0] is None or spec[0] == ()  # layer dim replicated
+        assert np.isfinite(hist[-1]["loss"])
+
+    def test_unscanned_layout_still_works(self, mesh_2d):
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        wl = get_workload(
+            "gpt2",
+            config=GPT2Config.tiny(scan_layers=False, remat=False),
+            batch_size=8, seq_len=32, grad_accum_steps=1,
+        )
+        state, hist = run_steps(wl, mesh_2d, 2)
+        qkv = state.params["h_0"]["c_attn"]["kernel"]
+        assert "tensor" in tuple(x for x in qkv.sharding.spec if x)
         assert np.isfinite(hist[-1]["loss"])
 
     def test_tp_matches_dp_loss(self, mesh_dp, mesh_2d):

@@ -1,0 +1,91 @@
+"""Record-file data path: the native C++ loader wired to workloads.
+
+Role: the reference reads real datasets through tf.data's C++ runtime
+(SURVEY.md §3.4); here the equivalent fast path is ``native.dtt_loader``
+over fixed-size-record files.  The record schema is derived mechanically
+from a workload's ``init_batch`` (field names, per-example shapes, dtypes),
+so every model family gets the native path with zero per-model code:
+
+    stage_synthetic_to_records(workload, "/data/resnet50.rec", 50_000)
+    python train.py --model=resnet50 --data_dir=/data      # uses C++ loader
+
+Sharding matches tf.data DATA auto-shard (record i -> shard i % nproc), so
+multi-host runs read disjoint slices.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from distributed_tensorflow_tpu.native import NativeRecordLoader, RecordFile
+
+logger = logging.getLogger(__name__)
+
+
+def record_schema(workload) -> RecordFile:
+    """RecordFile schema from a workload's init_batch (batch dim stripped)."""
+    fields = []
+    for name, arr in workload.init_batch.items():
+        a = np.asarray(arr)
+        fields.append((name, tuple(a.shape[1:]), a.dtype))
+    return RecordFile(fields)
+
+
+def record_path(data_dir: str, workload_name: str) -> str:
+    return os.path.join(data_dir, f"{workload_name}.rec")
+
+
+def stage_synthetic_to_records(
+    workload, path: str, num_examples: int, *, chunk: int = 512,
+) -> int:
+    """Materialize the workload's (synthetic) stream into a record file.
+
+    One-time offline prep (and the test fixture); real datasets convert
+    through the same ``RecordFile.write`` API.
+    """
+    schema = record_schema(workload)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    it = workload.data_fn(chunk)
+    written = 0
+    first = True
+    while written < num_examples:
+        batch = next(it)
+        take = min(chunk, num_examples - written)
+        batch = {k: np.asarray(v)[:take] for k, v in batch.items()}
+        schema.write(path, batch, append=not first)
+        first = False
+        written += take
+    logger.info("staged %d examples -> %s (%d bytes/record)",
+                written, path, schema.record_bytes)
+    return written
+
+
+def record_data_fn(
+    path: str,
+    workload,
+    *,
+    shuffle: bool = True,
+    num_threads: int = 2,
+    prefetch: int = 4,
+    seed: int = 0,
+):
+    """A ``data_fn``-shaped factory backed by the native loader."""
+
+    def data_fn(per_host_batch_size: int) -> Iterator[dict]:
+        loader = NativeRecordLoader(
+            path,
+            record_schema(workload),
+            batch_size=per_host_batch_size,
+            shuffle=shuffle,
+            num_threads=num_threads,
+            prefetch=prefetch,
+            seed=seed,
+        )
+        return iter(loader)
+
+    return data_fn

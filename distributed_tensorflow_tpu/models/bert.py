@@ -32,6 +32,7 @@ from flax import linen as nn
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_mlm
 from distributed_tensorflow_tpu.models import Workload
+from distributed_tensorflow_tpu.ops import flash_attention
 from distributed_tensorflow_tpu.parallel.sharding import (
     P,
     ShardingRules,
@@ -50,6 +51,11 @@ class BertConfig:
     d_ff: int = 3072
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
+    # scan-over-layers + per-layer remat (see GPT2Config for rationale)
+    scan_layers: bool = True
+    remat: bool = True
+    # Pallas fused attention (non-causal); drops attention-prob dropout
+    use_flash_attention: bool = False
 
     @classmethod
     def base(cls, **kw):
@@ -63,23 +69,30 @@ class BertConfig:
 
 class EncoderLayer(nn.Module):
     cfg: BertConfig
+    deterministic: bool = True  # attribute (not call arg) so nn.scan can map
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool):
+    def __call__(self, x, _=None):
         cfg = self.cfg
+        deterministic = self.deterministic
         d, h = cfg.d_model, cfg.n_head
         head_dim = d // h
-        B, T, _ = x.shape
+        B, T, _unused = x.shape
 
         qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, h, head_dim)
         k = k.reshape(B, T, h, head_dim)
         v = v.reshape(B, T, h, head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(cfg.dtype)
-        probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
+        if cfg.use_flash_attention:
+            ctx = flash_attention(q, k, v, causal=False).reshape(B, T, d)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), -1
+            ).astype(cfg.dtype)
+            probs = nn.Dropout(cfg.dropout, deterministic=deterministic)(probs)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
         attn = nn.Dense(d, dtype=cfg.dtype, name="out_proj")(ctx)
         attn = nn.Dropout(cfg.dropout, deterministic=deterministic)(attn)
         # Post-LN (original BERT)
@@ -89,7 +102,10 @@ class EncoderLayer(nn.Module):
         y = nn.gelu(y)
         y = nn.Dense(d, dtype=cfg.dtype, name="fc2")(y)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
+        out = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
+        # carry dtype must be stable across scanned layers (and bf16 is the
+        # intended inter-layer activation dtype anyway)
+        return out.astype(cfg.dtype), None
 
 
 class BertPretrain(nn.Module):
@@ -114,8 +130,23 @@ class BertPretrain(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         x = x.astype(cfg.dtype)
-        for i in range(cfg.n_layer):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+        if cfg.scan_layers:
+            body = (nn.remat(EncoderLayer, prevent_cse=False)
+                    if cfg.remat else EncoderLayer)
+            Scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+            )
+            x, _ = Scanned(
+                cfg, deterministic=deterministic, name="layers"
+            )(x)
+        else:
+            for i in range(cfg.n_layer):
+                x, _ = EncoderLayer(
+                    cfg, deterministic=deterministic, name=f"layer_{i}"
+                )(x)
 
         # MLM head: transform + tied decoder.
         y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm")(x)
@@ -173,6 +204,13 @@ def _loss_fn(module: nn.Module, deterministic: bool, params,
 def bert_rules() -> ShardingRules:
     return transformer_rules().extended(
         [
+            # scanned-stack layout (leading layer dim)
+            (r"layers/.*qkv/kernel", P(None, "fsdp", "tensor")),
+            (r"layers/.*out_proj/kernel", P(None, "tensor", "fsdp")),
+            (r"layers/.*fc1/kernel", P(None, "fsdp", "tensor")),
+            (r"layers/.*fc2/kernel", P(None, "tensor", "fsdp")),
+            (r"layers/.*(bias|scale)", P()),
+            # shared / per-layer layout
             (r"word_embeddings/embedding", P("tensor", "fsdp")),
             (r"(segment_embeddings/embedding|position_embeddings)", P()),
         ]

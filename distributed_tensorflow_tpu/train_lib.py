@@ -69,6 +69,7 @@ class TrainArgs:
     job_name: Optional[str] = None
     task_index: Optional[int] = None
     # io
+    data_dir: Optional[str] = None  # {data_dir}/{model}.rec -> native loader
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
     log_every: int = 50
@@ -101,6 +102,10 @@ def parse_args(argv=None) -> TrainArgs:
     p.add_argument("--job_name", type=str, default=None,
                    help="TF1 launcher contract: ps|worker|chief|evaluator")
     p.add_argument("--task_index", type=int, default=None)
+    p.add_argument("--data_dir", type=str, default=None,
+                   help="directory of {model}.rec record files; enables the "
+                        "native C++ input loader (falls back to synthetic "
+                        "data when unset)")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=1000)
     p.add_argument("--log_every", type=int, default=50)
@@ -249,7 +254,17 @@ def run(args: TrainArgs) -> Dict[str, Any]:
 
     # 4. Input pipeline: per-host slice -> global sharded arrays -> prefetch.
     host_bs = per_host_batch_size(workload.batch_size)
-    host_iter = workload.data_fn(host_bs)
+    if args.data_dir:
+        from distributed_tensorflow_tpu.data.records import (
+            record_data_fn,
+            record_path,
+        )
+
+        path = record_path(args.data_dir, args.model)
+        logger.info("native record loader: %s", path)
+        host_iter = record_data_fn(path, workload, seed=args.seed)(host_bs)
+    else:
+        host_iter = workload.data_fn(host_bs)
     bsh = batch_shardings[workload.example_key]
     data_iter = DevicePrefetchIterator(host_iter, bsh, prefetch=2)
 

@@ -212,7 +212,8 @@ class TestBert:
     def test_bert_tp_mesh(self, mesh_2d):
         wl = self._tiny()
         state, hist = run_steps(wl, mesh_2d, 2)
-        qkv = state.params["layer_0"]["qkv"]["kernel"]
+        qkv = state.params["layers"]["qkv"]["kernel"]  # scanned: (L, d, 3d)
+        assert qkv.ndim == 3
         assert "tensor" in tuple(x for x in qkv.sharding.spec if x)
         assert np.isfinite(hist[-1]["loss"])
 

@@ -32,6 +32,7 @@ from distributed_tensorflow_tpu.cluster.topology import (
     MESH_AXES,
     MeshConfig,
     Topology,
+    build_hybrid_mesh,
     build_mesh,
     single_axis_mesh,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "MESH_AXES",
     "MeshConfig",
     "Topology",
+    "build_hybrid_mesh",
     "build_mesh",
     "single_axis_mesh",
     "assert_same_program",

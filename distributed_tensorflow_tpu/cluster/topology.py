@@ -114,6 +114,48 @@ def build_mesh(
     )
 
 
+def build_hybrid_mesh(
+    config: MeshConfig = MeshConfig(),
+    *,
+    dcn_data_parallelism: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: the ``data`` axis spans slices over DCN, every other
+    axis stays inside a slice on ICI (SURVEY.md §8 PR8; the scaling-book
+    layout — cross-slice traffic is only the gradient allreduce).
+
+    ``dcn_data_parallelism`` defaults to the number of slices
+    (``device.slice_index`` granularity).  On single-slice / CPU platforms
+    this degrades to ``build_mesh`` exactly.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = (dcn_data_parallelism if dcn_data_parallelism is not None
+                else len(slice_ids))
+    if n_slices <= 1:
+        return build_mesh(config, devices)
+    sizes = config.axis_sizes(len(devices))
+    if sizes["data"] % n_slices:
+        raise ValueError(
+            f"data axis ({sizes['data']}) must be divisible by the DCN "
+            f"slice count ({n_slices}): cross-slice parallelism rides the "
+            "data axis"
+        )
+    ici_shape = dict(sizes, data=sizes["data"] // n_slices)
+    dcn_shape = {a: (n_slices if a == "data" else 1) for a in MESH_AXES}
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape[a] for a in MESH_AXES),
+        tuple(dcn_shape[a] for a in MESH_AXES),
+        devices=devices,
+        allow_split_physical_axes=True,
+    )
+    return Mesh(
+        dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
+    )
+
+
 def single_axis_mesh(
     axis: str = "data", devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:

@@ -145,3 +145,33 @@ class TestMesh:
         topo = Topology.detect()
         assert topo.num_devices == 8
         assert topo.platform == "cpu"
+
+
+class TestHybridMesh:
+    def test_single_slice_degrades_to_build_mesh(self):
+        import jax
+
+        from distributed_tensorflow_tpu.cluster import (
+            MeshConfig,
+            build_hybrid_mesh,
+            build_mesh,
+        )
+
+        # CPU devices have no slice_index -> one slice -> plain build_mesh
+        m = build_hybrid_mesh(MeshConfig(data=4, tensor=2))
+        ref = build_mesh(MeshConfig(data=4, tensor=2))
+        assert dict(m.shape) == dict(ref.shape)
+
+    def test_indivisible_data_axis_raises(self):
+        import jax
+        import pytest
+
+        from distributed_tensorflow_tpu.cluster import (
+            MeshConfig,
+            build_hybrid_mesh,
+        )
+
+        with pytest.raises(ValueError, match="divisible by the DCN"):
+            build_hybrid_mesh(
+                MeshConfig(data=4, tensor=2), dcn_data_parallelism=3,
+            )

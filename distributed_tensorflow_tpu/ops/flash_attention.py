@@ -1,4 +1,4 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
 Why a kernel at all: XLA materializes the (T, T) score matrix in HBM for the
 naive einsum formulation; the flash formulation streams K/V blocks through
@@ -8,18 +8,25 @@ fused CUDA attention inside TF's binary — SURVEY.md §2 L0.)
 
 Design:
 
-- Grid: (batch·heads, T/BLOCK_Q).  Each program owns one query block and
-  loops over key blocks in VMEM; running max / denominator / accumulator are
-  f32 VMEM scratch.
+- Forward grid: (batch·heads, T/BLOCK_Q).  Each program owns one query block
+  and loops over key blocks in VMEM; running max / denominator / accumulator
+  are f32 VMEM values.  When taken under ``jax.vjp`` the kernel also writes
+  the per-row logsumexp (LSE = m + log l) for the backward pass,
+  lane-broadcast to (…, T, 128) because Mosaic requires last-two-dims tiles
+  of (8, 128) (same layout as jax.experimental.pallas.ops.tpu.flash_attention).
 - Causal masking is positional inside the tile; with ``causal=True`` key
   blocks entirely above the diagonal are skipped by loop bound, not masked —
   ~2x fewer tiles for long sequences.
-- Backward: ``jax.custom_vjp`` whose bwd recomputes through the dense XLA
-  formulation.  Training long sequences should use
-  ``parallel.ring_attention`` (which shards T); this kernel's win is forward
-  throughput and memory (scoring, inference, short-to-mid T training fwd).
+- Backward (FlashAttention-2 schedule, no atomics): two kernels.
+  * dQ: grid over query blocks; loops over key blocks, recomputing
+    P = exp(S − LSE) per tile from the stored LSE (no (T,T) buffer).
+  * dK/dV: grid over key blocks; loops over query blocks.  Each program
+    accumulates its own dk/dv tile, so no cross-program reduction is needed.
+  Both compute Δ = rowsum(dO ∘ O) in-kernel from the saved output (cheap
+  elementwise on tiles already resident in VMEM) and use
+  dS = P ∘ (dP − Δ) · scale.
 - Non-TPU platforms and awkward shapes fall back to the dense XLA path with
-  identical numerics (f32 softmax).
+  identical numerics (f32 softmax); its backward is XLA autodiff.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ import numpy as np
 
 BLOCK_Q = 128
 BLOCK_K = 128
+LANES = 128  # Mosaic minimum lane tile; LSE is broadcast across it
 
 
 def _interpret() -> bool:
@@ -52,10 +60,11 @@ def _dense(q, k, v, *, causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len, causal, scale,
-            block_q, block_k):
+def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, seq_len, causal, scale,
+            block_q, block_k, save_lse):
     from jax.experimental import pallas as pl
 
+    lse_ref = rest[0] if save_lse else None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (block_q, D)
     D = q.shape[-1]
@@ -100,27 +109,44 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len, causal, scale,
     acc0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    if save_lse:
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
 
 
-def _flash_fwd_tpu(q, k, v, *, causal, scale):
+def _to_heads(x):
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_heads(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_tpu(q, k, v, *, causal, scale, save_lse):
+    """Returns out (B,T,H,D), and lse (B·H, T, LANES) f32 if save_lse."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, D = q.shape
     block_q = min(BLOCK_Q, T)
     block_k = min(BLOCK_K, T)
-    # (B, T, H, D) -> (B*H, T, D)
-    def to_heads(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
     grid = (B * H, pl.cdiv(T, block_q))
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
+    if save_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32))
+    res = pl.pallas_call(
         functools.partial(
             _kernel, seq_len=T, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, save_lse=save_lse,
         ),
         grid=grid,
         in_specs=[
@@ -128,14 +154,185 @@ def _flash_fwd_tpu(q, k, v, *, causal, scale):
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(qh, kh, vh)
+    if save_lse:
+        return _from_heads(res[0], B, H), res[1]
+    return _from_heads(res[0], B, H), None
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
+                   *, seq_len, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+    g = g_ref[0].astype(jnp.float32)          # (block_q, D)
+    o = o_ref[0].astype(jnp.float32)          # (block_q, D)
+    lse = lse_ref[0][:, :1]                   # (block_q, 1)
+    delta = jnp.sum(g * o, axis=-1, keepdims=True)  # Δ = rowsum(dO ∘ O)
+    D = q.shape[-1]
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        hi = ((qi + 1) * block_q - 1) // block_k + 1
+        hi = jnp.minimum(hi, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)                  # masked -> exp(-inf) = 0
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                     # (block_q, block_k)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq0 = jnp.zeros((block_q, D), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, hi, body, dq0).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
+                    dk_ref, dv_ref,
+                    *, seq_len, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)          # (block_k, D)
+    D = k.shape[-1]
+
+    num_q_blocks = pl.cdiv(seq_len, block_q)
+    if causal:
+        # lowest query block that intersects this key block's causal wedge
+        lo = (ki * block_k) // block_q
+    else:
+        lo = 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        g_blk = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        o_blk = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :1]
+        delta = jnp.sum(g_blk * o_blk, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                             # (block_q, block_k)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        # dV += P^T dO
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        # dK += dS^T Q
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(lo, num_q_blocks, body, (z, z))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, o, lse, g, *, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    block_q = min(BLOCK_Q, T)
+    block_k = min(BLOCK_K, T)
+    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
+    gh, oh = _to_heads(g), _to_heads(o)
+
+    common = dict(seq_len=T, causal=causal, scale=scale,
+                  block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, pl.cdiv(T, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # k
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # v
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # o
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # g
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(qh, kh, vh)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    )(qh, kh, vh, oh, gh, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * H, pl.cdiv(T, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # q
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # o
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # g
+            pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)),     # lse
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(qh, kh, vh, oh, gh, lse)
+
+    return (_from_heads(dq, B, H), _from_heads(dk, B, H),
+            _from_heads(dv, B, H))
 
 
 def _supported(q, causal):
@@ -150,21 +347,30 @@ def _supported(q, causal):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     if _supported(q, causal):
-        return _flash_fwd_tpu(q, k, v, causal=causal, scale=scale)
+        out, _ = _flash_fwd_tpu(q, k, v, causal=causal, scale=scale,
+                                save_lse=False)
+        return out
     return _dense(q, k, v, causal=causal, scale=scale)
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash(q, k, v, causal, scale), (q, k, v)
+    if _supported(q, causal):
+        out, lse = _flash_fwd_tpu(q, k, v, causal=causal, scale=scale,
+                                  save_lse=True)
+        return out, (q, k, v, out, lse)
+    return _dense(q, k, v, causal=causal, scale=scale), (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, o, lse = res
+    if o is None:
+        # Fallback path (non-TPU / awkward shapes): XLA autodiff of dense.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_bwd_tpu(q, k, v, o, lse, g, causal=causal, scale=scale)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

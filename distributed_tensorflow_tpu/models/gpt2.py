@@ -61,6 +61,10 @@ class GPT2Config:
     # Pallas fused attention (ops.flash_attention).  Disables attention-prob
     # dropout (the prob matrix never materializes); residual dropout stays.
     use_flash_attention: bool = False
+    # GPipe microbatches when the mesh's ``pipe`` axis > 1 (0 = auto: the
+    # largest of {4S, 2S, S} dividing the batch).  Bubble fraction is
+    # (S-1)/(M+S-1), so prefer M >= 4S.
+    pipe_microbatches: int = 0
 
     @classmethod
     def small(cls, **kw):
@@ -147,7 +151,20 @@ class GPT2(nn.Module):
         )
         x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
-        if cfg.scan_layers:
+        pipe = self.mesh.shape.get("pipe", 1) if self.mesh is not None else 1
+        if cfg.scan_layers and pipe > 1 and not self.is_initializing():
+            # GPipe path: same "blocks" parameter layout as the scanned
+            # stack (checkpoints and sharding rules are layout-stable in
+            # --pipe), applied through the pipeline schedule instead of a
+            # sequential scan.  Init still goes through nn.scan below.
+            if not deterministic and cfg.dropout > 0:
+                raise ValueError(
+                    "pipe>1 runs blocks deterministically (GPipe stage fn "
+                    "carries no per-layer rng); set dropout=0 — "
+                    "make_workload does this automatically"
+                )
+            x = self._pipelined_blocks(x, pipe)
+        elif cfg.scan_layers:
             body = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
             Scanned = nn.scan(
                 body,
@@ -171,6 +188,67 @@ class GPT2(nn.Module):
             "btd,vd->btv", x.astype(jnp.float32), wte.astype(jnp.float32)
         )
         return logits
+
+    def _pipelined_blocks(self, x, n_stages: int):
+        """Apply the scanned block stack through the GPipe schedule.
+
+        The (L, ...) "blocks" parameters are re-viewed as (S, L/S, ...) —
+        S contiguous stages of L/S layers — and fed to
+        ``parallel.pipeline.pipeline_apply`` (shard_map manual over ``pipe``
+        only, so TP/DP inside each stage stay GSPMD-driven).  Embeddings,
+        final LN, and the LM head run outside the pipeline, replicated over
+        the pipe axis.
+        """
+        from distributed_tensorflow_tpu.parallel.pipeline import (
+            pipeline_apply,
+        )
+
+        cfg = self.cfg
+        L, S = cfg.n_layer, n_stages
+        if L % S != 0:
+            raise ValueError(f"n_layer={L} not divisible by pipe={S}")
+        params = self.scope.get_variable("params", "blocks")
+        staged = jax.tree.map(
+            lambda p: jnp.reshape(p, (S, L // S) + p.shape[1:]), params
+        )
+        block = Block(cfg, mesh=None, deterministic=True)
+
+        def stage_fn(stage_params, h):
+            def body(h, layer_params):
+                h, _ = block.apply({"params": layer_params}, h)
+                return h, None
+
+            if cfg.remat:
+                body = jax.checkpoint(body, prevent_cse=False)
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        B, T, d = x.shape
+        M = cfg.pipe_microbatches or _auto_microbatches(B, S)
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        xm = jnp.reshape(x, (M, B // M, T, d))
+        if self.mesh is not None:
+            # Keep the microbatch (not the schedule) dim data-sharded.
+            xm = jax.lax.with_sharding_constraint(
+                xm,
+                jax.sharding.NamedSharding(
+                    self.mesh, P(None, ("data", "fsdp"))
+                ),
+            )
+        y = pipeline_apply(stage_fn, staged, xm, mesh=self.mesh, axis="pipe")
+        return jnp.reshape(y, (B, T, d))
+
+
+def _auto_microbatches(batch: int, n_stages: int) -> int:
+    """Largest of {4S, 2S, S} dividing the batch (bubble <= (S-1)/(5S-1))."""
+    for m in (4 * n_stages, 2 * n_stages, n_stages):
+        if batch >= m and batch % m == 0:
+            return m
+    raise ValueError(
+        f"global batch {batch} is not divisible by any of "
+        f"{{4,2,1}}x pipe={n_stages} microbatch counts"
+    )
 
 
 def _loss_fn(module: nn.Module, deterministic: bool, params,
@@ -200,11 +278,12 @@ def gpt2_rules() -> ShardingRules:
     """
     return transformer_rules().extended(
         [
-            # scanned-stack layout (leading layer dim)
-            (r"blocks/.*c_attn/kernel", P(None, "fsdp", "tensor")),
-            (r"blocks/.*c_proj/kernel", P(None, "tensor", "fsdp")),
-            (r"blocks/.*mlp_c_fc/kernel", P(None, "fsdp", "tensor")),
-            (r"blocks/.*(bias|scale)", P()),
+            # scanned-stack layout: leading layer dim rides the pipe axis
+            # (a no-op at pipe=1; stage-contiguous placement at pipe>1).
+            (r"blocks/.*c_attn/kernel", P("pipe", "fsdp", "tensor")),
+            (r"blocks/.*c_proj/kernel", P("pipe", "tensor", "fsdp")),
+            (r"blocks/.*mlp_c_fc/kernel", P("pipe", "fsdp", "tensor")),
+            (r"blocks/.*(bias|scale)", P("pipe")),
             # shared / per-layer layout
             (r"wte$", P("tensor", "fsdp")),
             (r"wpe$", P()),
@@ -228,6 +307,26 @@ def make_workload(
     cfg = config or getattr(GPT2Config, preset)()
     if use_flash_attention is not None:
         cfg = dataclasses.replace(cfg, use_flash_attention=use_flash_attention)
+    if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+        if not cfg.scan_layers:
+            raise ValueError(
+                "pipe>1 requires scan_layers=True (the GPipe path stages "
+                "the scanned block stack); the per-layer loop would "
+                "silently replicate over the pipe axis"
+            )
+        if mesh.shape.get("context", 1) > 1:
+            raise ValueError(
+                "pipe>1 with context>1 is unsupported: pipeline stages run "
+                "blocks locally (dense/flash attention), so the context "
+                "axis would be inert; pick one"
+            )
+        if cfg.dropout > 0:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pipe>1: disabling dropout (GPipe stage fn is deterministic)"
+            )
+            cfg = dataclasses.replace(cfg, dropout=0.0)
     seq = seq_len or min(cfg.n_positions, 1024)
     module = GPT2(cfg, mesh=mesh)
     # Init batch must divide over the batch-sharding axes (ring attention is

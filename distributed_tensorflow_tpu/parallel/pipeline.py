@@ -18,6 +18,13 @@ program —
 
 With M microbatches over S stages the bubble fraction is (S-1)/(M+S-1) —
 choose M >= 4*S for >80% utilization.
+
+Composition with the other mesh axes: the shard_map is *manual only over the
+pipe axis* (``axis_names={axis}``) — data/fsdp/tensor/context stay "auto",
+so GSPMD continues to shard the stage computation (TP matmuls, DP batch)
+inside each pipeline stage exactly as it does outside one.  That is how
+``--pipe`` composes with ``--tensor``/``--data`` without any collective
+appearing in model code.
 """
 
 from __future__ import annotations
@@ -68,14 +75,27 @@ def pipeline_apply(
         params0 = jax.tree.map(lambda p: p[0], stacked_params)
         return jax.vmap(lambda mb: stage_fn(params0, mb))(x)
     M = x.shape[0]
+    # 16-bit activations cross the shard_map boundary as f32: every boundary
+    # collective (the delivery psum below, and the x-cotangent psum the
+    # shard_map transpose emits in backward) must be f32, because XLA:CPU's
+    # AllReducePromotion pass crashes on the copy-bearing reducers the shardy
+    # VMA lowering produces for 16-bit all-reduces.  Compute inside the
+    # stages stays in the original dtype.
+    in_dtype = x.dtype
+    boundary_f32 = in_dtype in (jnp.bfloat16, jnp.float16)
 
     def _local(params, x_loc):
-        # params leaves: (1, ...) — this chip's stage; x_loc: (M, mb...)
+        # params leaves: (1, ...) — this chip's stage; x_loc: (M, mb...),
+        # f32 at the boundary when activations are 16-bit (see above).
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
         idx = lax.axis_index(axis)
         T = M + S - 1  # fill + steady + drain ticks
-        mb_zero = jnp.zeros_like(x_loc[0])
+        mb_zero = jnp.zeros(x_loc.shape[1:], in_dtype)
         perm = [(i, (i + 1) % S) for i in range(S)]
+        # A varying zero: adding it is the collective-free way to promote a
+        # value to pipe-varying (``lax.pcast`` would lower to a copy-reducer
+        # all-reduce — the XLA:CPU bug again).
+        vzero = (idx * 0).astype(x_loc.dtype)
 
         def tick(carry, t):
             recv, outbuf = carry
@@ -84,6 +104,10 @@ def pipeline_apply(
             x_t = lax.dynamic_index_in_dim(
                 x_loc, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
+            # Promote to varying BEFORE the 16-bit cast: the shard_map
+            # transpose inserts the x-cotangent psum at this promotion
+            # point, and it must be f32 (boundary rule above).
+            x_t = (x_t + vzero).astype(in_dtype)
             inp = jnp.where(idx == 0, x_t, recv)
             out = stage_fn(params, inp)
             # last stage owns finished microbatch j = t - (S-1)
@@ -98,17 +122,30 @@ def pipeline_apply(
             recv_next = lax.ppermute(out, axis, perm)
             return (recv_next, outbuf), None
 
-        outbuf0 = jnp.zeros((M,) + x_loc.shape[1:], x_loc.dtype)
+        outbuf0 = jnp.zeros((M,) + x_loc.shape[1:], in_dtype)
+        # VMA: the carry becomes pipe-varying inside the body (axis_index,
+        # ppermute); the initial value must be typed varying to match.
+        # Constants carry no cotangent, so this addition generates no
+        # transpose collective.
+        vzero_c = vzero.astype(in_dtype)
+        mb_zero = mb_zero + vzero_c
+        outbuf0 = outbuf0 + vzero_c
         (_, outbuf), _ = lax.scan(tick, (mb_zero, outbuf0), jnp.arange(T))
         # deliver result from the last stage to every stage (psum of a
-        # one-hot-masked buffer) so the output is replicated over the axis.
+        # one-hot-masked buffer) so the output is replicated over the axis;
+        # f32 per the boundary rule above (summing one non-zero shard is
+        # exact in any dtype).
         outbuf = jnp.where(idx == S - 1, outbuf, jnp.zeros_like(outbuf))
-        return lax.psum(outbuf, axis)
+        return lax.psum(outbuf.astype(jnp.float32), axis)
 
-    return jax.shard_map(
+    out = jax.shard_map(
         _local,
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(),
-        check_vma=False,
-    )(stacked_params, x)
+        axis_names={axis},
+        # partial-manual shard_map requires VMA checking; the body ends in a
+        # psum over `axis`, so the output is pipe-invariant as P() declares.
+        check_vma=True,
+    )(stacked_params, x.astype(jnp.float32) if boundary_f32 else x)
+    return out.astype(in_dtype)

@@ -183,6 +183,31 @@ def build_state_and_step(
     return state, state_shardings, train_step, batch_shardings
 
 
+# Mesh axes each workload can actually honor.  Axes a workload cannot honor
+# are hard errors, not silent replication (a --pipe the model ignores would
+# have N-1 of N devices doing duplicate work).
+_MODEL_AXES = {
+    "gpt2": {"pipe", "context"},
+}
+
+
+def validate_mesh_axes(args: TrainArgs) -> None:
+    """Reject mesh axes the selected workload does not implement."""
+    supported = _MODEL_AXES.get(args.model, set())
+    for axis, why in (
+        ("pipe", "GPipe pipeline stages"),
+        ("context", "ring attention / sequence parallelism"),
+        ("expert", "embedding-table sharding"),
+    ):
+        if getattr(args, axis) > 1 and axis not in supported:
+            raise ValueError(
+                f"--{axis}={getattr(args, axis)} ({why}) is not wired into "
+                f"--model={args.model}; it would silently replicate over "
+                f"the {axis!r} axis. Models supporting it: "
+                f"{sorted(m for m, a in _MODEL_AXES.items() if axis in a)}"
+            )
+
+
 def run(args: TrainArgs) -> Dict[str, Any]:
     """Full entrypoint. Returns final host metrics (for tests/benchmarks)."""
     # force=True: the TPU plugin may have configured root handlers already,
@@ -212,6 +237,7 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         return {}
 
     # 2. Mesh over the global device set.
+    validate_mesh_axes(args)
     mesh = cluster_lib.build_mesh(
         cluster_lib.MeshConfig(
             data=args.data, fsdp=args.fsdp, tensor=args.tensor,
@@ -360,6 +386,7 @@ def run_evaluator(args: TrainArgs) -> Dict[str, Any]:
     """
     import time as _time
 
+    validate_mesh_axes(args)
     mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(
         data=args.data, fsdp=args.fsdp, tensor=args.tensor,
         pipe=args.pipe, context=args.context, expert=args.expert,

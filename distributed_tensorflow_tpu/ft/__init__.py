@@ -13,10 +13,11 @@ from distributed_tensorflow_tpu.ft.preemption import (
     PreemptionWatcher,
     TerminationConfig,
 )
-from distributed_tensorflow_tpu.ft.health import HealthChecker
+from distributed_tensorflow_tpu.ft.health import HealthChecker, HealthCheckHook
 
 __all__ = [
     "HealthChecker",
+    "HealthCheckHook",
     "PreemptionCheckpointHook",
     "PreemptionWatcher",
     "TerminationConfig",

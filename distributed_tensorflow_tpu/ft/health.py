@@ -49,10 +49,20 @@ def make_default_probe(interval_s: float = 30.0):
         # nearest boundary: probes fire at boundary+eps, so round-to-nearest
         # tolerates skew/jitter of +-quantum/2 (vs floor's zero tolerance)
         rid = int((time.time() + quantum / 2) // quantum)
+        # jax._src.distributed is a private surface: resolve it defensively
+        # so a JAX upgrade degrades to "probe unavailable -> healthy" with a
+        # warning instead of counting every probe as a peer failure.
         try:
             client = jax._src.distributed.global_state.client
-            if client is None:
-                return True
+        except AttributeError:
+            logger.warning(
+                "health probe unavailable (jax distributed internals "
+                "changed); reporting healthy"
+            )
+            return True
+        if client is None:
+            return True
+        try:
             client.wait_at_barrier(
                 f"dtt_health_{rid}", timeout_in_ms=int(timeout_s * 1000)
             )
@@ -137,3 +147,24 @@ class HealthChecker:
     def raise_if_unhealthy(self) -> None:
         if self.error is not None:
             raise self.error
+
+
+class HealthCheckHook:
+    """Training-loop hook running a ``HealthChecker`` for the duration of the
+    loop: started at ``begin``, consulted at every step boundary (the worker
+    raises instead of hanging in a collective whose peer died — MWMS's
+    check-health thread behavior, $TF collective_all_reduce_strategy.py:340),
+    stopped at ``end``.
+    """
+
+    def __init__(self, checker: Optional[HealthChecker] = None, **kw):
+        self.checker = checker or HealthChecker(**kw)
+
+    def begin(self, loop) -> None:
+        self.checker.start()
+
+    def after_step(self, loop, step, metrics) -> None:
+        self.checker.raise_if_unhealthy()
+
+    def end(self, loop, step) -> None:
+        self.checker.stop()

@@ -29,10 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import linen as nn
+from jax.sharding import Mesh
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_mlm
 from distributed_tensorflow_tpu.models import Workload
 from distributed_tensorflow_tpu.ops import flash_attention
+from distributed_tensorflow_tpu.parallel.ring_attention import ring_attention
 from distributed_tensorflow_tpu.parallel.sharding import (
     P,
     ShardingRules,
@@ -69,6 +71,7 @@ class BertConfig:
 
 class EncoderLayer(nn.Module):
     cfg: BertConfig
+    mesh: Optional[Mesh] = None
     deterministic: bool = True  # attribute (not call arg) so nn.scan can map
 
     @nn.compact
@@ -84,7 +87,15 @@ class EncoderLayer(nn.Module):
         q = q.reshape(B, T, h, head_dim)
         k = k.reshape(B, T, h, head_dim)
         v = v.reshape(B, T, h, head_dim)
-        if cfg.use_flash_attention:
+        if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
+            # Long-context path: non-causal ring attention — sequence
+            # sharded over the `context` axis, KV rotating on the ICI ring.
+            # Exact attention (online softmax); attention-prob dropout is
+            # unavailable here, residual dropout remains.
+            ctx = ring_attention(
+                q, k, v, mesh=self.mesh, causal=False
+            ).reshape(B, T, d)
+        elif cfg.use_flash_attention:
             ctx = flash_attention(q, k, v, causal=False).reshape(B, T, d)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
@@ -110,6 +121,7 @@ class EncoderLayer(nn.Module):
 
 class BertPretrain(nn.Module):
     cfg: BertConfig
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, batch: Dict[str, jax.Array], *, deterministic: bool = True):
@@ -140,12 +152,14 @@ class BertPretrain(nn.Module):
                 length=cfg.n_layer,
             )
             x, _ = Scanned(
-                cfg, deterministic=deterministic, name="layers"
+                cfg, mesh=self.mesh, deterministic=deterministic,
+                name="layers",
             )(x)
         else:
             for i in range(cfg.n_layer):
                 x, _ = EncoderLayer(
-                    cfg, deterministic=deterministic, name=f"layer_{i}"
+                    cfg, mesh=self.mesh, deterministic=deterministic,
+                    name=f"layer_{i}",
                 )(x)
 
         # MLM head: transform + tied decoder.
@@ -222,17 +236,24 @@ def make_workload(
     batch_size: int = 256,
     seq_len: int = 128,
     config: Optional[BertConfig] = None,
+    mesh: Optional[Mesh] = None,
     **_unused,
 ) -> Workload:
     cfg = config or BertConfig.base()
     seq = min(seq_len, cfg.max_positions)
-    module = BertPretrain(cfg)
+    module = BertPretrain(cfg, mesh=mesh)
+    # Init batch must divide over the batch-sharding axes when the mesh
+    # forces the ring-attention shard_map path (static per-shard shapes),
+    # mirroring gpt2/wide_deep.
+    b0 = 2
+    if mesh is not None:
+        b0 = max(2, mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
     init_batch = {
-        "tokens": np.zeros((2, seq), np.int32),
-        "mlm_targets": np.zeros((2, seq), np.int32),
-        "mlm_mask": np.zeros((2, seq), np.float32),
-        "segment_ids": np.zeros((2, seq), np.int32),
-        "nsp_label": np.zeros((2,), np.int32),
+        "tokens": np.zeros((b0, seq), np.int32),
+        "mlm_targets": np.zeros((b0, seq), np.int32),
+        "mlm_mask": np.zeros((b0, seq), np.float32),
+        "segment_ids": np.zeros((b0, seq), np.int32),
+        "nsp_label": np.zeros((b0,), np.int32),
     }
     return Workload(
         name="bert",

@@ -188,6 +188,7 @@ def build_state_and_step(
 # have N-1 of N devices doing duplicate work).
 _MODEL_AXES = {
     "gpt2": {"pipe", "context"},
+    "bert": {"context"},
 }
 
 
@@ -297,6 +298,17 @@ def run(args: TrainArgs) -> Dict[str, Any]:
 
     # 5. Hooks.
     hooks = [LoggingHook(every_steps=args.log_every), NanHook()]
+    if jax.process_count() > 1:
+        # Peer-liveness fail-fast (MWMS check-health equivalent, SURVEY
+        # §6.3): a dead peer raises at the next step boundary instead of
+        # hanging this worker in a collective forever.
+        from distributed_tensorflow_tpu.ft import HealthCheckHook
+
+        interval = float(os.environ.get("DTT_HEALTH_INTERVAL_S", "30"))
+        hooks.append(HealthCheckHook(
+            interval_s=interval,
+            timeout_s=min(20.0, max(1.0, interval * 0.75)),
+        ))
     manager = None
     if args.checkpoint_dir:
         manager = CheckpointManager(

@@ -264,6 +264,21 @@ class TestBert:
         assert "tensor" in tuple(x for x in qkv.sharding.spec if x)
         assert np.isfinite(hist[-1]["loss"])
 
+    def test_bert_context_parallel_ring_matches_dp(self, mesh_dp, mesh_4d):
+        # mesh_4d has context=2: BERT switches to non-causal ring attention.
+        # Loss must match the dense-attention DP run (exact either way).
+        from distributed_tensorflow_tpu.models.bert import BertConfig
+
+        def make(mesh):
+            return get_workload(
+                "bert", config=BertConfig.tiny(), batch_size=8, seq_len=32,
+                mesh=mesh,
+            )
+
+        l_dp = [m["loss"] for m in run_steps(make(None), mesh_dp, 3)[1]]
+        l_cp = [m["loss"] for m in run_steps(make(mesh_4d), mesh_4d, 3)[1]]
+        np.testing.assert_allclose(l_dp, l_cp, rtol=2e-2)
+
     def test_bert_base_param_count(self):
         from distributed_tensorflow_tpu.models.bert import (
             BertConfig,

@@ -59,6 +59,89 @@ def _free_port():
     return port
 
 
+HEALTH_SCRIPT = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.ft import HealthChecker
+
+resolver = cluster_lib.resolve()
+server = cluster_lib.Server.from_resolver(resolver)
+assert jax.process_count() == 2
+
+if jax.process_index() == 1:
+    # the doomed peer: participate briefly, then die without cleanup
+    time.sleep(3.0)
+    os._exit(1)
+
+# survivor (process 0 = coordinator): a training-like loop with the health
+# checker; a dead peer must surface as a raise, not a hang.
+checker = HealthChecker(interval_s=2.0, timeout_s=1.5,
+                        failures_before_action=2).start()
+step = jax.jit(lambda x: x + 1)
+x = jnp.zeros(())
+deadline = time.time() + 60
+try:
+    while time.time() < deadline:
+        x = step(x)
+        checker.raise_if_unhealthy()
+        time.sleep(0.1)
+    print("HEALTH_TIMEOUT")  # checker never tripped: test failure
+except RuntimeError as e:
+    assert "unhealthy" in str(e), e
+    checker.stop()
+    print("HEALTH_RAISED", flush=True)
+    # Skip the atexit jax.distributed shutdown: its cluster-wide shutdown
+    # barrier can only fail against the dead peer and would turn this
+    # deliberate fail-fast into a noisy crash.
+    os._exit(0)
+finally:
+    checker.stop()
+"""
+
+
+def test_health_checker_detects_dead_peer(tmp_path):
+    """Killing one worker makes the survivor raise within ~2 probe
+    intervals (VERDICT weak #5 / SURVEY §6.3 MWMS check-health)."""
+    import json
+
+    p0, p1 = _free_port(), _free_port()
+    cluster = {"worker": [f"localhost:{p0}", f"localhost:{p1}"]}
+    procs = []
+    for idx in range(2):
+        env = dict(
+            os.environ,
+            TF_CONFIG=json.dumps(
+                {"cluster": cluster, "task": {"type": "worker", "index": idx}}
+            ),
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", HEALTH_SCRIPT],
+                env=env,
+                cwd=os.path.dirname(os.path.dirname(__file__)),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    try:
+        out0, _ = procs[0].communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        pytest.fail("survivor hung instead of failing fast")
+    procs[1].wait(timeout=30)
+    assert "HEALTH_RAISED" in out0, out0[-4000:]
+    assert procs[0].returncode == 0, out0[-4000:]
+
+
 def test_two_process_localhost_cluster(tmp_path):
     import json
 

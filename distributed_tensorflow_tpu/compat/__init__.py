@@ -12,6 +12,7 @@ from distributed_tensorflow_tpu.compat.v1 import (
     NcclAllReduce,
     ReductionToOneDevice,
     SyncReplicasOptimizer,
+    device,
     replica_device_setter,
 )
 
@@ -22,5 +23,6 @@ __all__ = [
     "NcclAllReduce",
     "ReductionToOneDevice",
     "SyncReplicasOptimizer",
+    "device",
     "replica_device_setter",
 ]

@@ -7,6 +7,7 @@ TPU-native engine (or is documented as subsumed by it).
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import logging
 from typing import Any, Callable, Optional, Sequence
 
@@ -44,6 +45,20 @@ def replica_device_setter(
         return ""
 
     return _device_fn
+
+
+@_contextlib.contextmanager
+def device(device_name_or_function=None):
+    """``tf.device`` call-shape shim for the reference's
+    ``with tf.device(replica_device_setter(...)):`` idiom (SURVEY.md §4.2).
+
+    Device placement is a property of arrays on TPU (NamedSharding), not a
+    graph-construction context, so this is a no-op context manager; the
+    sharding rules attached to the workload/strategy are the real placement
+    mechanism.  Accepts a string or a device function (what
+    ``replica_device_setter`` returns) for mechanical porting.
+    """
+    yield
 
 
 # -- SyncReplicasOptimizer (SURVEY.md §3.1, BERT path) ------------------------

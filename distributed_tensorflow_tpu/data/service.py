@@ -1,0 +1,298 @@
+"""Out-of-process input service — the tf.data service role (SURVEY.md §3.4).
+
+Behavioral model: ``$TF/python/data/experimental/service/server_lib.py`` —
+tf.data's dispatcher/worker servers move input processing out of the
+trainer processes so hosts don't each need a co-located pipeline (at pod
+scale input is the scaling killer, SURVEY.md §8).  TPU-native translation:
+one ``DataServiceServer`` process wraps the native C++ loader (mmap +
+shuffle + batch assembly off-GIL) and streams raw fixed-size-record batches
+over TCP; every consumer pulls from ONE shared stream, so consumers get
+disjoint batches — tf.data service's ``distributed_epoch`` processing mode.
+
+Wire protocol (deliberately schema-free; both sides derive the schema from
+the workload via ``records.record_schema``):
+
+  on connect   server -> client: 16-byte header = record_bytes (u64 LE)
+                                 + batch_size (u64 LE)      [handshake]
+  client -> server  1 byte  b"N" (next batch) | b"Q" (quit)
+  server -> client  8-byte u64 LE payload length + payload
+                    (batch_size * record_bytes); length 0 = stream end
+
+The payload is exactly the loader's batch buffer — no pickling, no
+serialization layer; the client unpacks with ``RecordFile.unpack`` just as
+the in-process path does.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from distributed_tensorflow_tpu.native import NativeRecordLoader, RecordFile
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+_HDR = struct.Struct("<QQ")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("data service peer closed mid-message")
+        got += r
+    return bytes(buf)
+
+
+class DataServiceServer:
+    """Serves one shared batch stream from a record file to N consumers.
+
+    The native loader's producer threads keep the prefetch ring full; each
+    consumer request pops one batch, so concurrent consumers partition the
+    epoch stream (no duplicated examples across trainers).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        record: RecordFile,
+        *,
+        batch_size: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        shuffle: bool = True,
+        num_threads: int = 2,
+        prefetch: int = 8,
+        seed: int = 0,
+    ):
+        self.record = record
+        self.batch_size = batch_size
+        # The service owns the WHOLE file: shard 0/1 regardless of the
+        # trainer topology (trainers split the stream by pulling, not by
+        # record striping).
+        self._loader = NativeRecordLoader(
+            path, record, batch_size=batch_size, shuffle=shuffle,
+            num_threads=num_threads, prefetch=prefetch, seed=seed,
+            shard_index=0, shard_count=1,
+        )
+        self._loader_lock = threading.Lock()
+        self._sock = socket.create_server((host, port))
+        self._host = host
+        self._port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._conns: list = []
+        self._conns_lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def target(self) -> str:
+        """Address for ``--data_service`` (tf.data service's dispatcher
+        target role)."""
+        return f"{self._host}:{self._port}"
+
+    def start(self) -> "DataServiceServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="dtt-data-service-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+        logger.info("data service serving %d-byte records at %s",
+                    self.record.record_bytes, self.target)
+        return self
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with self._conns_lock:
+                self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_one, args=(conn, addr), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_one(self, conn: socket.socket, addr) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            conn.sendall(
+                _HDR.pack(self.record.record_bytes, self.batch_size)
+            )
+            while not self._stop.is_set():
+                op = _recv_exact(conn, 1)
+                if op == b"Q":
+                    return
+                if op != b"N":
+                    raise ValueError(f"bad data-service opcode {op!r}")
+                # next_raw reuses the loader's output buffer: copy the
+                # bytes out under the lock, send outside it.  The raw
+                # buffer IS the wire format (fields concatenated per
+                # record) — no serialization layer.
+                try:
+                    with self._loader_lock:
+                        if self._stop.is_set():
+                            raise StopIteration  # stopped while we waited
+                        raw = self._loader.next_raw().tobytes()
+                except StopIteration:
+                    conn.sendall(_LEN.pack(0))  # clean end-of-stream frame
+                    return
+                conn.sendall(_LEN.pack(len(raw)) + raw)
+            # stop() requested: tell the consumer the stream is over.
+            conn.sendall(_LEN.pack(0))
+        except (ConnectionError, BrokenPipeError, OSError):
+            pass  # consumer went away; nothing to clean up server-side
+        finally:
+            conn.close()
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        # Unblock serve threads parked in recv (their conn.close() turns the
+        # pending _recv_exact into an OSError, exiting the thread cleanly).
+        with self._conns_lock:
+            for conn in list(self._conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=5)
+        # Under the loader lock: a serve thread may be inside next_raw();
+        # destroying the native handle out from under it would be a
+        # use-after-free in dtt_loader_next.
+        with self._loader_lock:
+            self._loader.close()
+
+    def join(self) -> None:
+        """Park like a server process (Server.join contract)."""
+        while not self._stop.wait(timeout=1.0):
+            pass
+
+
+class DataServiceIterator:
+    """Client iterator: pulls batches from a DataServiceServer.
+
+    Drop-in for the in-process loader's iterator (same unpacked dict
+    batches), so ``DevicePrefetchIterator`` stacks on top unchanged.
+    """
+
+    def __init__(self, address: str, record: RecordFile, batch_size: int):
+        host, port = address.rsplit(":", 1)
+        self.record = record
+        self.batch_size = batch_size
+        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        rec_bytes, srv_bs = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+        if rec_bytes != record.record_bytes:
+            raise ValueError(
+                f"data service at {address} serves {rec_bytes}-byte records "
+                f"but this workload's schema is {record.record_bytes} bytes "
+                "— wrong --model or stale record file on the server"
+            )
+        if srv_bs != batch_size:
+            raise ValueError(
+                f"data service batch_size {srv_bs} != trainer per-host "
+                f"batch size {batch_size}; start the server with the "
+                "trainer's per-host batch size"
+            )
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        self._sock.sendall(b"N")
+        (length,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+        if length == 0:
+            raise StopIteration
+        raw = _recv_exact(self._sock, length)
+        flat = np.frombuffer(raw, dtype=np.uint8).reshape(
+            self.batch_size, self.record.record_bytes
+        )
+        return self.record.unpack(flat)
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"Q")
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def data_service_data_fn(address: str, workload):
+    """``data_fn``-shaped factory consuming from a data service
+    (the client half of ``--data_service``)."""
+    from distributed_tensorflow_tpu.data.records import record_schema
+
+    def data_fn(per_host_batch_size: int) -> Iterator[dict]:
+        return DataServiceIterator(
+            address, record_schema(workload), per_host_batch_size
+        )
+
+    return data_fn
+
+
+def main(argv=None):
+    """CLI: serve a staged record file.
+
+    python -m distributed_tensorflow_tpu.data.service \
+        --model=mnist --data_dir=/data --batch_size=128 --port=7071
+    """
+    import argparse
+
+    from distributed_tensorflow_tpu.data.records import (
+        record_path,
+        record_schema,
+    )
+    from distributed_tensorflow_tpu.models import get_workload
+
+    p = argparse.ArgumentParser(description="record-file data service")
+    p.add_argument("--model", required=True)
+    p.add_argument("--data_dir", required=True)
+    p.add_argument("--batch_size", type=int, required=True,
+                   help="per-trainer-host batch size")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--num_threads", type=int, default=2)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, force=True)
+    workload = get_workload(args.model)
+    server = DataServiceServer(
+        record_path(args.data_dir, args.model),
+        record_schema(workload),
+        batch_size=args.batch_size,
+        host=args.host,
+        port=args.port,
+        seed=args.seed,
+        num_threads=args.num_threads,
+    ).start()
+    print(f"DATA_SERVICE_READY {server.target}", flush=True)
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -54,8 +54,13 @@ class Strategy:
         self._mesh = mesh if mesh is not None else build_mesh(MeshConfig())
         self._rules = ShardingRules()
         # per-fn jit cache: run() is the per-step API; a fresh jax.jit each
-        # call would retrace every step
+        # call would retrace every step.  Bounded: callers must pass a
+        # stable fn for caching to help (a fresh lambda per call retraces
+        # by construction); the bound keeps per-call-lambda misuse from
+        # growing compiled executables without limit.  (A weak-keyed cache
+        # cannot work here: jax.jit(fn) strongly references fn.)
         self._jitted: dict = {}
+        self._jitted_max = 128
 
     # -- core tf.distribute surface ------------------------------------------
     @contextlib.contextmanager
@@ -78,16 +83,23 @@ class Strategy:
         return shape.get("data", 1) * shape.get("fsdp", 1)
 
     def run(self, fn: Callable, args: tuple = (), kwargs: dict = None):
-        """jit fn over the mesh; array args are placed before the call.
+        """jit fn over the mesh; the *batch* argument is batch-sharded.
 
         The whole "per-replica function + cross-replica sync" model of the
         reference collapses here: fn sees global arrays and XLA partitions
         it over the mesh (SURVEY.md §4.1 "TPU-native").
+
+        Placement convention (mirrors TF's ``strategy.run(step_fn,
+        args=(per_replica_batch,))``): only the FIRST positional argument is
+        the batch and gets the batch sharding.  Remaining args (parameter /
+        optimizer pytrees, scalars) pass through untouched — they keep
+        whatever sharding ``place()``/``replicate()`` gave them, instead of
+        being stomped with the batch spec.
         """
         kwargs = kwargs or {}
         bsh = self.batch_sharding()
 
-        def _place(x):
+        def _place_batch(x):
             if isinstance(x, (np.ndarray, jax.Array)) and np.ndim(x) >= 1:
                 try:
                     return jax.device_put(x, bsh)
@@ -95,11 +107,14 @@ class Strategy:
                     return jax.device_put(x, NamedSharding(self._mesh, P()))
             return x
 
-        args = jax.tree.map(_place, args)
-        kwargs = jax.tree.map(_place, kwargs)
+        if args:
+            args = (jax.tree.map(_place_batch, args[0]),) + tuple(args[1:])
         jitted = self._jitted.get(fn)
         if jitted is None:
-            jitted = self._jitted.setdefault(fn, jax.jit(fn))
+            if len(self._jitted) >= self._jitted_max:
+                self._jitted.clear()  # per-call-lambda misuse: cap, retrace
+            jitted = jax.jit(fn)
+            self._jitted[fn] = jitted
         return jitted(*args, **kwargs)
 
     def reduce(self, reduce_op: str, value: PyTree, axis: Optional[int] = 0):

@@ -22,6 +22,7 @@ Two architectures, one workload family:
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -34,6 +35,13 @@ from jax.sharding import Mesh
 from distributed_tensorflow_tpu.data.pipeline import synthetic_recsys
 from distributed_tensorflow_tpu.models import Workload
 from distributed_tensorflow_tpu.parallel.embedding import ShardedEmbed
+from distributed_tensorflow_tpu.parallel.embedding_config import (
+    FeatureConfig,
+    MultiTableEmbedding,
+    TableConfig,
+    multi_table_optimizer,
+    multi_table_rules,
+)
 from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
 
 
@@ -81,6 +89,15 @@ class WideDeep(nn.Module):
 
 
 class DLRM(nn.Module):
+    """DLRM over either embedding source:
+
+    - default: one shared ``ShardedEmbed`` table for all sparse slots
+      (``vocab_size``), row-sharded on ``shard_axis``;
+    - ``feature_configs`` set: the TPUEmbedding-style multi-table path
+      (SURVEY.md §4.4) — N slots share M row-sharded tables on the
+      ``expert`` axis with per-table optimizers (see embedding_config).
+    """
+
     vocab_size: int
     emb_dim: int = 64
     bottom_layers: Sequence[int] = (512, 256, 64)
@@ -88,6 +105,24 @@ class DLRM(nn.Module):
     mesh: Optional[Mesh] = None
     shard_axis: str = "data"
     dtype: Any = jnp.bfloat16
+    feature_configs: Optional[Sequence[FeatureConfig]] = None
+
+    def _embed(self, sparse: jax.Array) -> jax.Array:
+        """(B, F) ids -> (B, F, D) embeddings, per the configured source."""
+        if self.feature_configs is None:
+            return ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
+                                axis=self.shard_axis, name="deep_embed")(sparse)
+        fcs = tuple(self.feature_configs)
+        assert sparse.shape[-1] == len(fcs), (
+            f"sparse has {sparse.shape[-1]} slots, config has {len(fcs)}"
+        )
+        assert all(fc.table.dim == self.emb_dim for fc in fcs), (
+            "DLRM dot interactions need every table dim == emb_dim"
+        )
+        acts = MultiTableEmbedding(
+            fcs, mesh=self.mesh, axis=self.shard_axis, name="embed"
+        )({fc.name: sparse[:, i] for i, fc in enumerate(fcs)})
+        return jnp.stack([acts[fc.name] for fc in fcs], axis=1)
 
     @nn.compact
     def __call__(self, batch: Dict[str, jax.Array]):
@@ -98,8 +133,7 @@ class DLRM(nn.Module):
         bottom = MLP(self.bottom_layers, self.dtype, name="bottom")(
             dense.astype(self.dtype)
         )  # (B, D)
-        emb = ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
-                           axis=self.shard_axis, name="deep_embed")(sparse)
+        emb = self._embed(sparse)
         vectors = jnp.concatenate(
             [bottom[:, None, :], emb.astype(self.dtype)], axis=1
         )  # (B, 1+F, D)
@@ -112,6 +146,32 @@ class DLRM(nn.Module):
         top_in = jnp.concatenate([bottom, inter], axis=-1)
         logit = MLP(self.top_layers, self.dtype, name="top")(top_in)
         return logit.astype(jnp.float32).squeeze(-1)
+
+
+def criteo_tables(
+    num_sparse: int = 26,
+    emb_dim: int = 64,
+    *,
+    vocab_sizes: Sequence[int] = (1_000_000, 100_000, 10_000),
+    embedding_lr: float = 1e-2,
+) -> Tuple[FeatureConfig, ...]:
+    """Default multi-table config: the ``num_sparse`` slots share 3 tables
+    in Criteo-like cardinality tiers (a handful of huge tables, many small).
+
+    The large table carries a per-table Adagrad — the classic recsys choice
+    for sparse features (TPUEmbedding's per-table optimizer role,
+    tpu_embedding_v2_utils.py:1319) — while the rest use the model default.
+    """
+    tables = [
+        TableConfig(vocab_sizes[0], emb_dim, name="table_large",
+                    optimizer=optax.adagrad(embedding_lr)),
+        TableConfig(vocab_sizes[1], emb_dim, name="table_medium"),
+        TableConfig(vocab_sizes[2], emb_dim, name="table_small"),
+    ]
+    return tuple(
+        FeatureConfig(table=tables[i % len(tables)], name=f"slot_{i}")
+        for i in range(num_sparse)
+    )
 
 
 def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
@@ -141,9 +201,36 @@ def make_workload(
     num_sparse: int = 26,
     mesh: Optional[Mesh] = None,
     shard_axis: str = "data",
+    feature_configs: Optional[Sequence[FeatureConfig]] = None,
     **_unused,
 ) -> Workload:
-    if arch == "wide_deep":
+    # Multi-table path: explicit config, or automatically when the mesh has
+    # an expert axis to shard tables over (--expert N).
+    multi_table = feature_configs is not None or (
+        mesh is not None and mesh.shape.get("expert", 1) > 1
+    )
+    make_opt = None
+    if multi_table:
+        if arch != "dlrm":
+            raise ValueError(
+                "multi-table embeddings (feature_configs / --expert>1) are "
+                f"wired into arch='dlrm', got arch={arch!r}"
+            )
+        fcs = tuple(feature_configs or criteo_tables(num_sparse, emb_dim))
+        vocab_size = max(fc.table.vocabulary_size for fc in fcs)
+        shard_axis = "expert"
+        module = DLRM(
+            vocab_size=vocab_size, feature_configs=fcs, emb_dim=emb_dim,
+            mesh=mesh, shard_axis=shard_axis,
+            bottom_layers=(512, 256, emb_dim),
+        )
+        rules = multi_table_rules(fcs, axis=shard_axis)
+
+        def make_opt(schedule):
+            return multi_table_optimizer(
+                fcs, default_tx=optax.adamw(schedule, weight_decay=1e-4)
+            )
+    elif arch == "wide_deep":
         module = WideDeep(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
                           shard_axis=shard_axis)
     elif arch == "dlrm":
@@ -152,10 +239,16 @@ def make_workload(
                       bottom_layers=(512, 256, emb_dim))
     else:
         raise ValueError(f"unknown arch {arch!r}")
-    # Init batch must divide evenly over the shard axis (the lookup is a
-    # shard_map program with static per-shard shapes).
-    b0 = mesh.shape.get(shard_axis, 1) if mesh is not None else 2
-    b0 = max(b0, 2)
+    # Init batch must divide evenly over the shard axis AND the batch axes
+    # (the lookup is a shard_map program with static per-shard shapes) —
+    # lcm, not max: e.g. expert=4 with data=3 needs b0 % 3 == 0 too.
+    if mesh is not None:
+        b0 = max(2, math.lcm(
+            mesh.shape.get(shard_axis, 1),
+            mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1),
+        ))
+    else:
+        b0 = 2
     init_batch = {
         "dense": np.zeros((b0, num_dense), np.float32),
         "sparse": np.zeros((b0, num_sparse), np.int32),
@@ -174,10 +267,11 @@ def make_workload(
             batch_size=per_host_bs, num_dense=num_dense,
             num_sparse=num_sparse, vocab_size=vocab_size, holdout=True,
         ),
-        rules=recsys_rules(shard_axis),
+        rules=rules if multi_table else recsys_rules(shard_axis),
         batch_size=batch_size,
         learning_rate=1e-3,
         warmup_steps=100,
         example_key="dense",
         init_key=None,
+        make_optimizer=make_opt,
     )

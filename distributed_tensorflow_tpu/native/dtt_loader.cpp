@@ -65,10 +65,13 @@ class Loader {
       shard_records_.push_back(i);
     }
     if (shard_records_.empty()) { ok_ = false; return; }
+    order_ = shard_records_;
+    epoch_cursor_ = order_.size();  // force initial (re)shuffle
+    epoch_rng_.seed(seed_ * 0x9E3779B97F4A7C15ull + 1);
     uint64_t n = num_threads < 1 ? 1 : num_threads;
     stop_.store(false);
     for (uint64_t t = 0; t < n; ++t) {
-      threads_.emplace_back([this, t] { Produce(t); });
+      threads_.emplace_back([this] { Produce(); });
     }
   }
 
@@ -105,23 +108,34 @@ class Loader {
   }
 
  private:
-  // Each producer thread draws record ids from a per-thread epoch stream
-  // (distinct seeds) and assembles full batches off-GIL.
-  void Produce(uint64_t tid) {
-    std::mt19937_64 rng(seed_ * 0x9E3779B97F4A7C15ull + tid + 1);
-    std::vector<uint64_t> order(shard_records_);
-    size_t cursor = order.size();  // force initial (re)shuffle
+  // Draw one batch worth of record ids from the SINGLE shared epoch
+  // stream.  The shared cursor partitions each epoch's shuffled order
+  // across producer threads, so every record appears exactly once per
+  // epoch window regardless of num_threads — the tf.data DATA epoch
+  // contract, and identical semantics to the single-stream numpy
+  // fallback.  One mutex acquisition per batch, not per record; the
+  // expensive part (record gather) stays outside the lock.
+  void NextIds(std::vector<uint64_t>& ids) {
+    ids.clear();
+    std::unique_lock<std::mutex> lk(epoch_mu_);
+    for (uint64_t i = 0; i < batch_size_; ++i) {
+      if (epoch_cursor_ >= order_.size()) {
+        if (shuffle_) std::shuffle(order_.begin(), order_.end(), epoch_rng_);
+        epoch_cursor_ = 0;
+      }
+      ids.push_back(order_[epoch_cursor_++]);
+    }
+  }
+
+  // Producer threads assemble full batches off-GIL from shared epoch ids.
+  void Produce() {
     Batch b;
+    std::vector<uint64_t> ids;
     while (!stop_.load()) {
+      NextIds(ids);
       b.data.resize(batch_size_ * record_bytes_);
       for (uint64_t i = 0; i < batch_size_; ++i) {
-        if (cursor >= order.size()) {
-          if (shuffle_) {
-            std::shuffle(order.begin(), order.end(), rng);
-          }
-          cursor = 0;
-        }
-        const uint8_t* src = base_ + order[cursor++] * record_bytes_;
+        const uint8_t* src = base_ + ids[i] * record_bytes_;
         std::memcpy(b.data.data() + i * record_bytes_, src, record_bytes_);
       }
       {
@@ -146,6 +160,10 @@ class Loader {
   uint64_t prefetch_, seed_, shard_index_, shard_count_;
   bool ok_ = true;
   std::vector<uint64_t> shard_records_;
+  std::mutex epoch_mu_;
+  std::vector<uint64_t> order_;
+  size_t epoch_cursor_ = 0;
+  std::mt19937_64 epoch_rng_;
   std::vector<std::thread> threads_;
   std::mutex mu_;
   std::condition_variable cv_pop_, cv_push_;

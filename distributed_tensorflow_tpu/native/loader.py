@@ -156,6 +156,7 @@ class NativeRecordLoader:
         )
         self._lib = _load_library()
         self._handle = None
+        self._closed = False
         self._out = np.empty(
             (batch_size, record.record_bytes), dtype=np.uint8
         )
@@ -197,6 +198,16 @@ class NativeRecordLoader:
         return self
 
     def __next__(self) -> dict:
+        return self.record.unpack(self.next_raw())
+
+    def next_raw(self) -> np.ndarray:
+        """Next batch as raw (batch, record_bytes) uint8 — records in wire
+        format (the data service's payload).  The returned array is only
+        valid until the following call (reused buffer)."""
+        if self._closed:
+            # A closed native loader would otherwise fall through to the
+            # numpy-fallback branch (no _records) — fail as exhaustion.
+            raise StopIteration
         if self._handle is not None:
             rc = self._lib.dtt_loader_next(
                 self._handle,
@@ -205,7 +216,7 @@ class NativeRecordLoader:
             )
             if rc != 0:
                 raise StopIteration
-            return self.record.unpack(self._out)
+            return self._out
         # numpy fallback
         idx = np.empty(self.batch_size, np.int64)
         for i in range(self.batch_size):
@@ -215,9 +226,10 @@ class NativeRecordLoader:
                 self._cursor = 0
             idx[i] = self._order[self._cursor]
             self._cursor += 1
-        return self.record.unpack(self._records[idx])
+        return self._records[idx]
 
     def close(self) -> None:
+        self._closed = True
         if self._handle is not None and self._lib is not None:
             self._lib.dtt_loader_destroy(self._handle)
             self._handle = None

@@ -60,6 +60,12 @@ def sharded_lookup(
     table: (V, D) with V % mesh.shape[axis] == 0 (see ``pad_vocab``).
     ids:   integer array whose leading dim is the (sharded) batch.
     Returns ids.shape + (D,), batch-sharded like ``ids``.
+
+    ``batch_axes`` may differ from ``axis`` (table on a model axis, batch on
+    the data axes): ids are then *replicated* over ``axis``, the all-gather
+    produces n identical id blocks, and the psum_scatter hands every
+    ``axis`` rank the same complete rows — i.e. the result is correct and
+    axis-replicated, matching ``out_specs``.
     """
     n = mesh.shape[axis]
     if n == 1:
@@ -69,7 +75,9 @@ def sharded_lookup(
         raise ValueError(f"vocab {vocab} not divisible by {axis}={n}; "
                          "pad with pad_vocab()")
     rows_per_shard = vocab // n
-    batch_axes = tuple(batch_axes) if batch_axes is not None else (axis,)
+    if batch_axes is None:
+        batch_axes = (axis,)
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
 
     def _local(table_shard, ids_shard):
         # (1) ids everywhere (ints are tiny next to rows)
@@ -107,6 +115,12 @@ class ShardedEmbed(nn.Module):
     mesh: Optional[Mesh] = None
     axis: str = "data"
     param_dtype: Any = jnp.float32
+    # Mesh axes the ids' batch dim is sharded over.  None means the table
+    # axis itself (the classic DP-table layout).  When the table lives on a
+    # *model* axis (e.g. "expert") while the batch is data-sharded, pass the
+    # data axes here: the exchange then delivers every batch shard its rows
+    # replicated over the table axis (see sharded_lookup).
+    batch_axes: Optional[Sequence[str]] = None
 
     def setup(self):
         n = self.mesh.shape.get(self.axis, 1) if self.mesh is not None else 1
@@ -122,7 +136,8 @@ class ShardedEmbed(nn.Module):
         if self.mesh is None or self.mesh.shape.get(self.axis, 1) == 1:
             return jnp.take(self.embedding, ids, axis=0)
         return sharded_lookup(
-            self.embedding, ids, mesh=self.mesh, axis=self.axis
+            self.embedding, ids, mesh=self.mesh, axis=self.axis,
+            batch_axes=self.batch_axes,
         )
 
     def make_rule(self) -> tuple:

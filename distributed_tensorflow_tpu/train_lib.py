@@ -51,8 +51,9 @@ logger = logging.getLogger(__name__)
 class TrainArgs:
     model: str = "mnist"
     arch: Optional[str] = None  # sub-architecture (wide_deep | dlrm)
-    flash_attention: bool = False  # gpt2: Pallas fused attention (4.3x on
-    # v5e; replaces attention-prob dropout with none — see GPT2Config)
+    flash_attention: bool = False  # gpt2: Pallas fused attention, forward
+    # and backward (~4.5x tokens/s on v5e; drops attention-prob dropout —
+    # see GPT2Config)
     steps: int = 200
     batch_size: Optional[int] = None  # global; default from workload
     grad_accum_steps: Optional[int] = None
@@ -70,6 +71,7 @@ class TrainArgs:
     task_index: Optional[int] = None
     # io
     data_dir: Optional[str] = None  # {data_dir}/{model}.rec -> native loader
+    data_service: Optional[str] = None  # host:port of a data.service server
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
     log_every: int = 50
@@ -107,6 +109,10 @@ def parse_args(argv=None) -> TrainArgs:
                    help="directory of {model}.rec record files; enables the "
                         "native C++ input loader (falls back to synthetic "
                         "data when unset)")
+    p.add_argument("--data_service", type=str, default=None,
+                   help="host:port of an out-of-process input server "
+                        "(data.service — the tf.data-service role); "
+                        "mutually exclusive with --data_dir")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=1000)
     p.add_argument("--log_every", type=int, default=50)
@@ -189,6 +195,7 @@ def build_state_and_step(
 _MODEL_AXES = {
     "gpt2": {"pipe", "context"},
     "bert": {"context"},
+    "wide_deep": {"expert"},  # multi-table embeddings shard over expert
 }
 
 
@@ -282,7 +289,17 @@ def run(args: TrainArgs) -> Dict[str, Any]:
 
     # 4. Input pipeline: per-host slice -> global sharded arrays -> prefetch.
     host_bs = per_host_batch_size(workload.batch_size)
-    if args.data_dir:
+    if args.data_service and args.data_dir:
+        raise ValueError("--data_service and --data_dir are mutually "
+                         "exclusive (the service owns the record file)")
+    if args.data_service:
+        from distributed_tensorflow_tpu.data.service import (
+            data_service_data_fn,
+        )
+
+        logger.info("out-of-process input service: %s", args.data_service)
+        host_iter = data_service_data_fn(args.data_service, workload)(host_bs)
+    elif args.data_dir:
         from distributed_tensorflow_tpu.data.records import (
             record_data_fn,
             record_path,

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""TF1 between-graph parameter-server launcher, in the reference's idiom.
+
+This is the executable demonstration that a reference-style TF1 PS training
+script ports mechanically onto the TPU-native engine (SURVEY.md §4.2 — the
+launcher spawns ``--job_name={ps|worker} --task_index=i`` processes; each
+builds a ``ClusterSpec`` + ``Server``; ps tasks ``join()``, workers build the
+model under ``replica_device_setter`` placement and train through
+``MonitoredTrainingSession`` with ``SyncReplicasOptimizer``).
+
+Every TF1 idiom below maps onto the one TPU-native mechanism:
+
+=========================================  ==================================
+reference idiom                            what runs here
+=========================================  ==================================
+``tf.train.ClusterSpec({...})``            ``cluster.ClusterSpec`` (same ctor)
+``tf.distribute.Server(cluster, job, i)``  ``cluster.Server`` — compute tasks
+                                           join the JAX runtime; ps tasks park
+``server.join()`` (ps)                     identical blocking contract
+``tf.device(replica_device_setter(...))``  no-op context: placement is mesh
+                                           sharding, not a graph mode
+``SyncReplicasOptimizer(opt, N)``          sync aggregation of N microbatch
+                                           grads via optax.MultiSteps inside
+                                           the compiled step
+``MonitoredTrainingSession(master=...)``   chief-only CheckpointManager +
+                                           hook list driving TrainLoop
+``sess.run(train_op)`` hot loop            one compiled XLA step (allreduce
+                                           on ICI, no gRPC RecvTensor)
+=========================================  ==================================
+
+Run single-process (also what tests/test_examples.py does)::
+
+    python examples/tf1_ps_launcher.py --train_steps 8
+
+Run as a PS cluster, reference style (ps parks; worker 0 trains)::
+
+    python examples/tf1_ps_launcher.py --ps_hosts=localhost:2222 \
+        --worker_hosts=localhost:2223 --job_name=ps --task_index=0 &
+    python examples/tf1_ps_launcher.py --ps_hosts=localhost:2222 \
+        --worker_hosts=localhost:2223 --job_name=worker --task_index=0
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+# Allow running straight from a checkout (examples/ is not a package).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import optax
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu import compat as tf1
+from distributed_tensorflow_tpu.data import (
+    DevicePrefetchIterator,
+    per_host_batch_size,
+)
+from distributed_tensorflow_tpu.models import get_workload
+from distributed_tensorflow_tpu.models.bert import BertConfig
+from distributed_tensorflow_tpu.train_lib import build_state_and_step
+from distributed_tensorflow_tpu.training import (
+    CheckpointHook,
+    LoggingHook,
+    NanHook,
+    TrainLoop,
+)
+
+
+def parse_flags(argv=None):
+    # The reference's flag surface (tf.app.flags idiom).
+    p = argparse.ArgumentParser(description="TF1-style PS launcher (BERT-tiny)")
+    p.add_argument("--ps_hosts", default="", help="comma-separated ps addrs")
+    p.add_argument("--worker_hosts", default="", help="comma-separated worker addrs")
+    p.add_argument("--job_name", default="worker", choices=("ps", "worker", "chief"))
+    p.add_argument("--task_index", type=int, default=0)
+    p.add_argument("--train_steps", type=int, default=20)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--seq_len", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--sync_replicas", type=int, default=2,
+                   help="SyncReplicasOptimizer replicas_to_aggregate")
+    p.add_argument("--checkpoint_dir", default=None)
+    p.add_argument("--log_every", type=int, default=5)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO, force=True)
+    flags = parse_flags(argv)
+
+    # 1. ClusterSpec + Server — tf.train.ClusterSpec / tf.distribute.Server
+    #    ($TF/python/training/server_lib.py:243,:96).  Empty host flags mean
+    #    single-process (the reference's local-run mode).
+    cluster = {}
+    if flags.ps_hosts:
+        cluster["ps"] = flags.ps_hosts.split(",")
+    if flags.worker_hosts:
+        cluster["worker"] = flags.worker_hosts.split(",")
+    if not cluster:
+        cluster["worker"] = ["localhost:0"]
+    cluster_spec = cluster_lib.ClusterSpec(cluster)
+    server = cluster_lib.Server(
+        cluster_spec, job_name=flags.job_name, task_index=flags.task_index
+    )
+
+    if flags.job_name == "ps":
+        # ps tasks serve nothing on TPU (parameters are mesh-resident);
+        # they park exactly like the reference's `server.join()`.
+        server.join()
+        return None
+
+    is_chief = flags.task_index == 0 and flags.job_name in ("worker", "chief")
+
+    # 2. Model under replica_device_setter — the variable-placement idiom.
+    #    Placement is really the workload's sharding rules over the mesh.
+    num_ps = cluster_spec.num_tasks("ps") if "ps" in cluster_spec.jobs else 0
+    with tf1.device(tf1.replica_device_setter(ps_tasks=num_ps, cluster=cluster_spec)):
+        workload = get_workload(
+            "bert",
+            config=BertConfig.tiny(),
+            batch_size=flags.batch_size,
+            seq_len=flags.seq_len,
+        )
+
+    # 3. SyncReplicasOptimizer — N-microbatch sync aggregation per update.
+    opt = tf1.SyncReplicasOptimizer(
+        optax.adam(flags.learning_rate),
+        replicas_to_aggregate=flags.sync_replicas,
+        total_num_replicas=flags.sync_replicas,
+    )
+    workload.make_optimizer = lambda schedule: opt.as_gradient_transformation()
+
+    # 4. The TPU-native engine: mesh + sharded state + one compiled step.
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig())
+    state, _, train_step, batch_shardings = build_state_and_step(
+        workload, mesh, total_steps=flags.train_steps
+    )
+
+    # 5. MonitoredTrainingSession — chief-only checkpointing + hooks.
+    manager, hooks = tf1.MonitoredTrainingSession(
+        master=server.target,
+        is_chief=is_chief,
+        checkpoint_dir=flags.checkpoint_dir,
+        hooks=[LoggingHook(every_steps=flags.log_every), NanHook()],
+        save_checkpoint_steps=max(1, flags.train_steps // 2),
+    )
+    hooks.append(opt.make_session_run_hook(is_chief))
+    if manager is not None:
+        hooks.append(
+            CheckpointHook(manager, every_steps=max(1, flags.train_steps // 2))
+        )
+
+    host_bs = per_host_batch_size(workload.batch_size)
+    data_iter = DevicePrefetchIterator(
+        workload.data_fn(host_bs),
+        batch_shardings[workload.example_key],
+        prefetch=2,
+    )
+
+    # 6. The sess.run(train_op) loop.
+    loop = TrainLoop(
+        train_step,
+        state,
+        data_iter,
+        hooks=hooks,
+        examples_per_step=workload.batch_size,
+        metrics_every=min(5, flags.log_every),
+    )
+    loop.run(flags.train_steps)
+    loss = loop.last_logged_metrics.get("loss")
+    print(f"TF1_PS_LAUNCHER_DONE loss={loss}", flush=True)
+    server.shutdown()
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Out-of-process input service tests (the tf.data-service role,
+SURVEY.md §3.4 / VERDICT missing #2): one server process owns the record
+file + native loader; trainers pull disjoint batches over TCP.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.records import (
+    record_path,
+    record_schema,
+    stage_synthetic_to_records,
+)
+from distributed_tensorflow_tpu.data.service import (
+    DataServiceIterator,
+    DataServiceServer,
+)
+from distributed_tensorflow_tpu.models import get_workload
+from distributed_tensorflow_tpu.native import RecordFile
+
+REPO = os.path.dirname(os.path.dirname(__file__))
+
+
+@pytest.fixture
+def indexed_record(tmp_path):
+    """64 records whose 'label' field encodes the record index."""
+    rec = RecordFile([("x", (4,), np.float32), ("label", (), np.int32)])
+    n = 64
+    rng = np.random.RandomState(0)
+    arrays = {
+        "x": rng.randn(n, 4).astype(np.float32),
+        "label": np.arange(n, dtype=np.int32),
+    }
+    path = str(tmp_path / "idx.rec")
+    rec.write(path, arrays)
+    return path, rec, arrays
+
+
+class TestDataService:
+    def test_round_trip(self, indexed_record):
+        path, rec, arrays = indexed_record
+        server = DataServiceServer(path, rec, batch_size=8,
+                                   shuffle=False, num_threads=1).start()
+        try:
+            it = DataServiceIterator(server.target, rec, 8)
+            b = next(it)
+            np.testing.assert_array_equal(b["label"], np.arange(8))
+            np.testing.assert_allclose(b["x"], arrays["x"][:8])
+            it.close()
+        finally:
+            server.stop()
+
+    def test_consumers_split_one_stream(self, indexed_record):
+        """Two consumers never see the same batch (distributed_epoch
+        semantics): one epoch of batches is partitioned across them."""
+        path, rec, _ = indexed_record
+        server = DataServiceServer(path, rec, batch_size=16,
+                                   shuffle=True, num_threads=2).start()
+        try:
+            a = DataServiceIterator(server.target, rec, 16)
+            b = DataServiceIterator(server.target, rec, 16)
+            labels_a, labels_b = [], []
+            for _ in range(2):  # 4 batches total = 64 records = 1 epoch
+                labels_a.extend(next(a)["label"].tolist())
+                labels_b.extend(next(b)["label"].tolist())
+            # within one epoch window the two consumers are disjoint
+            assert set(labels_a) | set(labels_b) == set(range(64))
+            assert not set(labels_a) & set(labels_b)
+            a.close()
+            b.close()
+        finally:
+            server.stop()
+
+    def test_handshake_rejects_schema_mismatch(self, indexed_record):
+        path, rec, _ = indexed_record
+        server = DataServiceServer(path, rec, batch_size=8).start()
+        try:
+            wrong = RecordFile([("x", (8,), np.float32)])
+            with pytest.raises(ValueError, match="record"):
+                DataServiceIterator(server.target, wrong, 8)
+            with pytest.raises(ValueError, match="batch"):
+                DataServiceIterator(server.target, rec, 4)
+        finally:
+            server.stop()
+
+    def test_train_from_service(self, tmp_path):
+        """train_lib's --data_service path: mnist trains from an in-process
+        server thread end to end."""
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        wl = get_workload("mnist", batch_size=32)
+        path = record_path(str(tmp_path), "mnist")
+        stage_synthetic_to_records(wl, path, 256)
+        server = DataServiceServer(
+            path, record_schema(wl), batch_size=32
+        ).start()
+        try:
+            result = run(TrainArgs(
+                model="mnist", steps=10, batch_size=32, log_every=5,
+                data_service=server.target,
+            ))
+            assert result["final_step"] == 10
+            assert np.isfinite(result["loss"])
+        finally:
+            server.stop()
+
+    def test_out_of_process_server(self, tmp_path):
+        """VERDICT #7 done-criterion: a REAL separate server process (the
+        CLI) feeds a training run in this process."""
+        wl = get_workload("mnist", batch_size=32)
+        path = record_path(str(tmp_path), "mnist")
+        stage_synthetic_to_records(wl, path, 256)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tensorflow_tpu.data.service",
+             "--model=mnist", f"--data_dir={tmp_path}", "--batch_size=32"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("DATA_SERVICE_READY"), line
+            target = line.split()[1]
+
+            from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+            result = run(TrainArgs(
+                model="mnist", steps=10, batch_size=32, log_every=5,
+                data_service=target,
+            ))
+            assert result["final_step"] == 10
+            assert np.isfinite(result["loss"])
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)

@@ -103,3 +103,132 @@ class TestRecsysWorkloads:
         losses = [m["loss"] for m in hist]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+class TestMultiTableEmbedding:
+    """TPUEmbedding TableConfig/FeatureConfig surface (VERDICT missing #5,
+    $TF/python/tpu/tpu_embedding_v2_utils.py:1319,:1538)."""
+
+    def _small_config(self, emb_dim=8, num_sparse=6):
+        from distributed_tensorflow_tpu.models.wide_deep import criteo_tables
+
+        return criteo_tables(
+            num_sparse, emb_dim, vocab_sizes=(64, 32, 16), embedding_lr=1e-2
+        )
+
+    @pytest.fixture
+    def mesh_expert(self, devices8):
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+
+        return build_mesh(MeshConfig(data=2, expert=4), devices8)
+
+    def test_lookup_matches_dense_per_table(self, mesh_expert):
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            MultiTableEmbedding,
+        )
+
+        fcs = self._small_config()
+        mod = MultiTableEmbedding(fcs, mesh=mesh_expert, axis="expert")
+        rng = np.random.RandomState(3)
+        feats = {
+            fc.name: jnp.asarray(
+                rng.randint(0, 1 << 20, size=(8,)).astype(np.int32)
+            )
+            for fc in fcs
+        }
+        vars_ = mod.init(jax.random.key(0), feats)
+        out = mod.apply(vars_, feats)
+        for fc in fcs:
+            table = vars_["params"][fc.table.name]["embedding"]
+            ids = feats[fc.name] % fc.table.vocabulary_size
+            want = jnp.take(table, ids, axis=0)
+            np.testing.assert_allclose(
+                np.asarray(out[fc.name]), np.asarray(want), rtol=1e-6
+            )
+
+    def test_features_share_tables(self, mesh_expert):
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            MultiTableEmbedding,
+        )
+
+        fcs = self._small_config(num_sparse=6)  # 6 features over 3 tables
+        mod = MultiTableEmbedding(fcs, mesh=mesh_expert, axis="expert")
+        feats = {fc.name: jnp.zeros((4,), jnp.int32) for fc in fcs}
+        vars_ = mod.init(jax.random.key(0), feats)
+        # exactly 3 parameter tables despite 6 features
+        assert sorted(vars_["params"]) == [
+            "table_large", "table_medium", "table_small",
+        ]
+
+    def test_multivalent_combiner(self, mesh_expert):
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            FeatureConfig,
+            MultiTableEmbedding,
+            TableConfig,
+        )
+
+        t = TableConfig(16, 4, name="t", combiner="mean")
+        fcs = (FeatureConfig(table=t, name="f"),)
+        mod = MultiTableEmbedding(fcs, mesh=None)
+        ids = jnp.asarray([[0, 1, 2], [3, 3, 3]], jnp.int32)  # (B=2, K=3)
+        vars_ = mod.init(jax.random.key(0), {"f": ids})
+        out = mod.apply(vars_, {"f": ids})
+        table = vars_["params"]["t"]["embedding"]
+        want = jnp.take(table, ids, axis=0).mean(axis=1)
+        assert out["f"].shape == (2, 4)
+        np.testing.assert_allclose(np.asarray(out["f"]), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_dlrm_from_config_trains_expert_sharded(self, mesh_expert):
+        from tests.test_models import run_steps
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            assert_table_residency,
+        )
+
+        fcs = self._small_config()
+        wl = get_workload(
+            "wide_deep", arch="dlrm", batch_size=32, emb_dim=8,
+            num_sparse=len(fcs), feature_configs=fcs, mesh=mesh_expert,
+        )
+        state, hist = run_steps(wl, mesh_expert, 6)
+        losses = [m["loss"] for m in hist]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        # every table (not just one) really lives row-sharded on 'expert'
+        assert_table_residency(state.params, fcs, axis="expert")
+
+    def test_expert_axis_triggers_multi_table(self, mesh_expert):
+        """--expert>1 without explicit configs builds the multi-table DLRM
+        on the expert axis (the axis finally earns its place)."""
+        wl = get_workload(
+            "wide_deep", arch="dlrm", batch_size=32, emb_dim=8,
+            num_sparse=6, mesh=mesh_expert,
+        )
+        assert wl.module.feature_configs is not None  # multi-table DLRM
+        assert wl.module.shard_axis == "expert"
+        assert wl.make_optimizer is not None  # per-table optimizer wired
+
+    def test_per_table_optimizer_branches(self):
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            multi_table_optimizer,
+        )
+        import optax
+
+        fcs = self._small_config()
+        tx = multi_table_optimizer(fcs, default_tx=optax.sgd(1.0))
+        params = {
+            "embed": {
+                "table_large": {"embedding": jnp.ones((4, 2))},
+                "table_medium": {"embedding": jnp.ones((4, 2))},
+            },
+            "dense": {"kernel": jnp.ones((2, 2))},
+        }
+        st = tx.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = tx.update(grads, st, params)
+        # sgd(1.0) branch: update == -grad; adagrad branch differs
+        np.testing.assert_allclose(
+            np.asarray(updates["dense"]["kernel"]), -1.0, rtol=1e-6
+        )
+        large = np.asarray(updates["embed"]["table_large"]["embedding"])
+        assert not np.allclose(large, -1.0)  # took the per-table branch

@@ -73,17 +73,33 @@ resolver = cluster_lib.resolve()
 server = cluster_lib.Server.from_resolver(resolver)
 assert jax.process_count() == 2
 
+# BOTH processes run checkers (probes are barriers — they need every live
+# peer participating).  Sync the start so the first probe boundary finds
+# both checkers running, making the healthy phase deterministic.
+cluster_lib.barrier("health_test_start")
+checker = HealthChecker(interval_s=2.0, timeout_s=1.5,
+                        failures_before_action=2).start()
+
 if jax.process_index() == 1:
-    # the doomed peer: participate briefly, then die without cleanup
-    time.sleep(3.0)
+    # the doomed peer: probe healthily for ~3 intervals, then die without
+    # cleanup mid-run
+    time.sleep(6.5)
     os._exit(1)
 
 # survivor (process 0 = coordinator): a training-like loop with the health
-# checker; a dead peer must surface as a raise, not a hang.
-checker = HealthChecker(interval_s=2.0, timeout_s=1.5,
-                        failures_before_action=2).start()
+# checker.  Phase 1: peer alive -> probes must SUCCEED (a probe that
+# reports unhealthy on a healthy cluster would kill real training runs).
 step = jax.jit(lambda x: x + 1)
 x = jnp.zeros(())
+t0 = time.time()
+while time.time() - t0 < 5.5:
+    x = step(x)
+    checker.raise_if_unhealthy()   # raises -> healthy-phase failure
+    time.sleep(0.1)
+print("HEALTH_PHASE1_OK", flush=True)
+
+# Phase 2: peer dies at ~6.5s -> a dead peer must surface as a raise within
+# ~2 probe intervals, not a hang.
 deadline = time.time() + 60
 try:
     while time.time() < deadline:
@@ -138,6 +154,7 @@ def test_health_checker_detects_dead_peer(tmp_path):
             q.kill()
         pytest.fail("survivor hung instead of failing fast")
     procs[1].wait(timeout=30)
+    assert "HEALTH_PHASE1_OK" in out0, out0[-4000:]  # healthy phase exercised
     assert "HEALTH_RAISED" in out0, out0[-4000:]
     assert procs[0].returncode == 0, out0[-4000:]
 

@@ -101,6 +101,31 @@ class TestLoader:
                 )
         loader.close()
 
+    def test_multithreaded_epoch_has_no_repeats(self, record, record_path):
+        """ADVICE r1: producer threads must share ONE epoch stream — with
+        num_threads>1 every record appears exactly once per epoch window
+        (tf.data DATA contract), not ~Nx with per-thread shuffles."""
+        path, _ = record_path
+        # num_threads=2/prefetch=1 bound the draw-ahead window: the 8
+        # consumed batches come from the first <=11 drawn (2 in-flight + 1
+        # ring slot), i.e. <2.75 epochs, so a shared stream can repeat a
+        # record at most 3x.  Per-thread duplicate streams would be
+        # ~Poisson(2) per record: max 5-6 w.h.p. — the cap discriminates.
+        loader = NativeRecordLoader(
+            path, record, batch_size=16, shuffle=True, seed=7,
+            shard_index=0, shard_count=1, num_threads=2, prefetch=1,
+        )
+        labels = []
+        for _ in range(8):  # 2 epochs of 64 records
+            labels.extend(next(loader)["label"].tolist())
+        counts = np.bincount(np.asarray(labels), minlength=64)
+        assert counts.sum() == 128
+        assert counts.max() <= 3, (
+            f"record seen {counts.max()}x within 2 epochs — per-thread "
+            "duplicate shuffle streams?"
+        )
+        loader.close()
+
     def test_numpy_fallback_parity(self, record, record_path, monkeypatch):
         from distributed_tensorflow_tpu.native import loader as loader_mod
 

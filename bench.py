@@ -6,8 +6,16 @@ reference numbers exist (BASELINE.json "published": {} and the reference
 mount was empty — SURVEY.md §0/§7), so ``vs_baseline`` is reported against
 the first value this repo itself recorded in BASELINE.md's ladder; until one
 exists it is 1.0 by definition.
+
+``--input=loader`` times the SAME training loop fed by the real input path
+(staged record file -> native C++ loader -> DevicePrefetchIterator) instead
+of one cached device batch — the end-to-end number including input
+(SURVEY.md §8: the input pipeline is the usual scaling killer).  The driver
+runs the default (cached) mode; the loader mode exists so BASELINE.md can
+report both and their gap.
 """
 
+import argparse
 import json
 import os
 import time
@@ -15,7 +23,14 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", choices=("cached", "loader"), default="cached")
+    ap.add_argument("--records", type=int, default=1024,
+                    help="loader mode: records to stage (reused if present)")
+    ap.add_argument("--data_dir", default="/tmp/dtt_bench_data",
+                    help="loader mode: staging directory")
+    flags = ap.parse_args(argv)
     import jax
     import jax.numpy as jnp
 
@@ -48,19 +63,42 @@ def main():
         wl, mesh, precision=BF16, total_steps=warmup + iters,
     )
     sh = batch_sh[wl.example_key]
-    it = make_global_batches(
-        wl.data_fn(per_host_batch_size(wl.batch_size)), sh
-    )
+    host_bs = per_host_batch_size(wl.batch_size)
+    if flags.input == "loader":
+        from distributed_tensorflow_tpu.data.pipeline import (
+            DevicePrefetchIterator,
+        )
+        from distributed_tensorflow_tpu.data.records import (
+            record_data_fn,
+            record_path,
+            record_schema,
+            stage_synthetic_to_records,
+        )
+
+        path = record_path(flags.data_dir, wl.name)
+        want = record_schema(wl).file_size(flags.records)
+        if not (os.path.exists(path) and os.path.getsize(path) == want):
+            stage_synthetic_to_records(wl, path, flags.records)
+        data_iter = iter(DevicePrefetchIterator(
+            record_data_fn(path, wl, num_threads=2, prefetch=4)(host_bs),
+            sh, prefetch=2,
+        ))
+    else:
+        import itertools
+
+        it = make_global_batches(wl.data_fn(host_bs), sh)
+        data_iter = itertools.repeat(next(it))  # infinite cached batch
 
     rng = jax.random.key(0)
-    b = next(it)
     for i in range(warmup):
-        state, m = train_step(state, b, jax.random.fold_in(rng, i))
+        state, m = train_step(state, next(data_iter),
+                              jax.random.fold_in(rng, i))
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for i in range(iters):
-        state, m = train_step(state, b, jax.random.fold_in(rng, warmup + i))
+        state, m = train_step(state, next(data_iter),
+                              jax.random.fold_in(rng, warmup + i))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
@@ -72,7 +110,15 @@ def main():
     # read nor write the baseline and report under a distinct metric name.
     baseline_file = os.path.join(os.path.dirname(__file__), ".bench_baseline.json")
     vs_baseline = 1.0
-    if on_tpu:
+    if on_tpu and flags.input == "loader":
+        # loader-fed mode compares against the cached anchor (same units)
+        # but never writes it — the anchor stays the cached-batch number.
+        if os.path.exists(baseline_file):
+            with open(baseline_file) as f:
+                recorded = json.load(f)
+            if recorded.get("value"):
+                vs_baseline = per_chip / float(recorded["value"])
+    elif on_tpu:
         if os.path.exists(baseline_file):
             # Never overwrite an existing anchor — a corrupt file is a hard
             # error, not a license to re-baseline.
@@ -89,11 +135,14 @@ def main():
             except OSError:
                 pass
 
+    if on_tpu:
+        metric = "resnet50_images_per_sec_per_chip"
+        if flags.input == "loader":
+            metric += "_loader_fed"
+    else:
+        metric = "resnet_tiny_cpu_smoke_images_per_sec"
     print(json.dumps({
-        "metric": (
-            "resnet50_images_per_sec_per_chip" if on_tpu
-            else "resnet_tiny_cpu_smoke_images_per_sec"
-        ),
+        "metric": metric,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 4),

@@ -1,0 +1,164 @@
+"""TFRecord → RecordFile conversion: real-dataset ingestion for --data_dir.
+
+Role: the reference's datasets (ImageNet, wiki dumps) ship as TFRecord
+shards read by tf.data's C++ runtime (SURVEY.md §3.4).  The native loader
+here reads fixed-size records (``native.RecordFile``), so real data flows
+in through a ONE-TIME offline conversion:
+
+    from distributed_tensorflow_tpu.data.convert import convert_tfrecords
+    convert_tfrecords(
+        glob.glob("/data/imagenet/train-*"),
+        record_path("/data/dtt", "resnet50"),
+        workload=get_workload("resnet50"),
+        transform=my_decode_and_resize,   # tf.train.Example dict -> arrays
+    )
+    # then: python train.py --model=resnet50 --data_dir=/data/dtt
+
+Pieces:
+
+- ``iter_tfrecord(path)``: pure-python reader of the TFRecord wire format
+  (u64 length + masked crc32c + payload + crc — the framing written by
+  TFRecordWriter).  CRCs are not verified (we are converting, not serving;
+  a corrupt length still fails fast on framing).
+- ``parse_example(buf)``: tf.train.Example protobuf -> {name: np.ndarray}
+  (bytes features stay ``object`` arrays — decode them in ``transform``).
+- ``convert_tfrecords(...)``: streams examples through ``transform`` and
+  batches them into the workload's RecordFile schema, applying the
+  workload's ``to_record`` staging transform (e.g. uint8 image
+  quantization) exactly like the synthetic staging path.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+
+
+def iter_tfrecord(path: str) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(12)  # u64 length + u32 masked-crc(length)
+            if not hdr:
+                return
+            if len(hdr) < 12:
+                raise ValueError(f"{path}: truncated TFRecord header")
+            (length,) = _U64.unpack(hdr[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                raise ValueError(f"{path}: truncated TFRecord payload")
+            f.read(4)  # masked-crc(payload); not verified
+            yield payload
+
+
+def parse_example(buf: bytes) -> Dict[str, np.ndarray]:
+    """Decode a tf.train.Example into {feature_name: np.ndarray}."""
+    try:
+        from tensorflow.core.example import example_pb2
+    except ImportError as e:  # pragma: no cover - tf is in this image
+        raise ImportError(
+            "parse_example needs the tensorflow protos; pass a custom "
+            "parse_fn to convert_tfrecords instead"
+        ) from e
+    ex = example_pb2.Example.FromString(buf)
+    out: Dict[str, np.ndarray] = {}
+    for name, feat in ex.features.feature.items():
+        kind = feat.WhichOneof("kind")
+        if kind == "int64_list":
+            out[name] = np.asarray(feat.int64_list.value, np.int64)
+        elif kind == "float_list":
+            out[name] = np.asarray(feat.float_list.value, np.float32)
+        elif kind == "bytes_list":
+            vals = list(feat.bytes_list.value)
+            out[name] = np.asarray(vals, dtype=object)
+        else:  # empty feature
+            out[name] = np.asarray([], np.float32)
+    return out
+
+
+def convert_tfrecords(
+    tfrecord_paths: Sequence[str],
+    out_path: str,
+    *,
+    workload,
+    transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+    parse_fn: Optional[Callable[[bytes], Dict[str, np.ndarray]]] = None,
+    limit: Optional[int] = None,
+    chunk: int = 512,
+) -> int:
+    """Convert TFRecord shards into the workload's RecordFile at out_path.
+
+    ``transform`` maps one parsed example to the workload's per-example
+    field dict (decode/resize/relabel here); identity when the TFRecord
+    features already match the schema.  Returns examples written.
+    """
+    from distributed_tensorflow_tpu.data.records import record_schema
+
+    import os
+
+    parse = parse_fn or parse_example
+    schema = record_schema(workload)
+    staged_fields = {n: (s, d) for n, s, d in schema.fields}
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    # Atomic output: chunks stream into .tmp; the final rename publishes a
+    # complete file (a crashed conversion never leaves a partial .rec a
+    # loader would happily serve).
+    tmp_path = out_path + ".tmp"
+
+    def example_stream() -> Iterator[Dict[str, np.ndarray]]:
+        for path in tfrecord_paths:
+            for payload in iter_tfrecord(path):
+                ex = parse(payload)
+                yield transform(ex) if transform is not None else ex
+
+    written = 0
+    first = True
+    batch: Dict[str, list] = {n: [] for n in staged_fields}
+    raw_names = None
+
+    def flush():
+        nonlocal written, first
+        if not next(iter(batch.values())):
+            return
+        arrays = {}
+        b = {k: np.asarray(v) for k, v in batch.items()}
+        if workload.to_record is not None:
+            b = workload.to_record(b)
+        for name, (shape, dtype) in staged_fields.items():
+            arrays[name] = np.asarray(b[name], dtype=dtype).reshape(
+                (-1,) + tuple(shape)
+            )
+        schema.write(tmp_path, arrays, append=not first)
+        first = False
+        written += len(next(iter(arrays.values())))
+        for v in batch.values():
+            v.clear()
+
+    key0 = next(iter(staged_fields))
+    for i, ex in enumerate(example_stream()):
+        missing = batch.keys() - ex.keys()
+        if missing:
+            raise ValueError(
+                f"example {i} lacks schema fields {sorted(missing)} "
+                f"(has {sorted(ex)}); supply a transform= that produces "
+                "the workload's fields"
+            )
+        for name in batch:
+            batch[name].append(ex[name])
+        if limit is not None and written + len(batch[key0]) >= limit:
+            break
+        if len(batch[key0]) >= chunk:
+            flush()
+    flush()
+    if written:
+        os.replace(tmp_path, out_path)
+    logger.info("converted %d examples -> %s", written, out_path)
+    return written

@@ -28,9 +28,17 @@ logger = logging.getLogger(__name__)
 
 
 def record_schema(workload) -> RecordFile:
-    """RecordFile schema from a workload's init_batch (batch dim stripped)."""
+    """RecordFile schema from a workload's init_batch (batch dim stripped).
+
+    With ``workload.to_record`` set, the schema reflects the STAGED form
+    (e.g. uint8-quantized images) — what actually lives on disk and moves
+    through the host pipeline.
+    """
+    batch = workload.init_batch
+    if workload.to_record is not None:
+        batch = workload.to_record(batch)
     fields = []
-    for name, arr in workload.init_batch.items():
+    for name, arr in batch.items():
         a = np.asarray(arr)
         fields.append((name, tuple(a.shape[1:]), a.dtype))
     return RecordFile(fields)
@@ -57,6 +65,8 @@ def stage_synthetic_to_records(
         batch = next(it)
         take = min(chunk, num_examples - written)
         batch = {k: np.asarray(v)[:take] for k, v in batch.items()}
+        if workload.to_record is not None:
+            batch = workload.to_record(batch)
         schema.write(path, batch, append=not first)
         first = False
         written += take

@@ -107,6 +107,38 @@ class PreemptionWatcher:
         self._event.set()
 
 
+_PSM_UNAVAILABLE_LOGGED = False
+
+
+def reached_platform_sync_point(step: int) -> bool:
+    """Platform-delivered preemption notice via JAX's preemption sync
+    manager (SURVEY.md §6.3): ``jax.distributed.initialize`` starts the
+    manager (jax/_src/distributed.py:169), the cluster scheduler's notice
+    (SIGTERM by default, watched inside the runtime) propagates to every
+    host, and the public ``multihost_utils.reached_preemption_sync_point``
+    agrees on the safe stopping step.
+
+    Contract (from the JAX API): call at EVERY step with the global step
+    id.  Returns False when single-process or the service is unavailable
+    (older runtimes) — the allgather-OR signal path still covers those.
+    """
+    global _PSM_UNAVAILABLE_LOGGED
+    if jax.process_count() <= 1:
+        return False
+    try:
+        from jax.experimental import multihost_utils
+
+        return bool(multihost_utils.reached_preemption_sync_point(int(step)))
+    except RuntimeError as e:
+        if not _PSM_UNAVAILABLE_LOGGED:
+            _PSM_UNAVAILABLE_LOGGED = True
+            logger.warning(
+                "jax preemption sync manager unavailable (%s); relying on "
+                "the signal-watcher path only", e,
+            )
+        return False
+
+
 def _any_host_preempted(local: bool) -> bool:
     """Cluster OR-reduce of the local preemption flag."""
     if jax.process_count() <= 1:
@@ -143,16 +175,28 @@ class PreemptionCheckpointHook(Hook):
             self.watcher.uninstall()
 
     def after_step(self, loop, step, metrics):
-        if self.handled or step % self.sync_every != 0:
+        if self.handled:
+            return
+        # Platform path: the JAX preemption sync manager must be consulted
+        # every step (it picks the safe step itself); cheap local check.
+        if reached_platform_sync_point(step):
+            self._save_and_stop(loop, step, "platform preemption notice")
+            return
+        # Signal path: our watcher's flag, OR-reduced over hosts on the
+        # sync_every cadence.
+        if step % self.sync_every != 0:
             return
         if _any_host_preempted(self.watcher.preempted):
-            self.handled = True
-            logger.warning(
-                "cluster-wide preemption detected at step %d: saving "
-                "checkpoint and stopping", step,
-            )
-            self.manager.save(step, loop.state, force=True)
-            self.manager.wait_until_finished()
-            loop.request_stop()
-            if self.exit_fn is not None:
-                self.exit_fn()
+            self._save_and_stop(loop, step, "preemption signal")
+
+    def _save_and_stop(self, loop, step, reason: str) -> None:
+        self.handled = True
+        logger.warning(
+            "cluster-wide preemption (%s) at step %d: saving checkpoint "
+            "and stopping", reason, step,
+        )
+        self.manager.save(step, loop.state, force=True)
+        self.manager.wait_until_finished()
+        loop.request_stop()
+        if self.exit_fn is not None:
+            self.exit_fn()

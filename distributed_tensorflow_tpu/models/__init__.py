@@ -53,6 +53,16 @@ class Workload:
     # Held-out input stream for evaluation (same task, disjoint examples).
     # None falls back to data_fn (eval-on-train; only for quick smoke runs).
     eval_data_fn: Optional[Callable[[int], Iterator[Dict[str, Any]]]] = None
+    # Optional host-side staging transform applied when writing record
+    # files (data.records): e.g. quantize f32 images to uint8 so the host
+    # pipeline (disk, loader memcpy, host->device transfer) moves 4x fewer
+    # bytes.  The record schema is derived from to_record(init_batch) when
+    # set.  Its inverse, ``from_record``, runs ON DEVICE inside the
+    # compiled step (train_lib wraps the loss fns with it) and must be a
+    # no-op for batches that never went through staging (dtype check) —
+    # the pair keeps the staging mechanism self-contained per workload.
+    to_record: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
+    from_record: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
 
 
 _REGISTRY = {

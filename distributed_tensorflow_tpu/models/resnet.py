@@ -34,6 +34,36 @@ from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
 
 ModuleDef = Any
 
+# uint8 staging quantization for images (records on disk / host->device
+# wire): u8 = clip(x * IMG_SCALE + IMG_OFFSET).  Covers roughly x in
+# [-4, +4) — ample for normalized image data — at ~1/32 resolution.  Real
+# ImageNet pipelines feed uint8 pixels and normalize on device for the same
+# reason: the host path (disk, loader memcpy, transfer) is the scarce
+# resource, not TPU flops.
+IMG_SCALE = 32.0
+IMG_OFFSET = 128.0
+
+
+def quantize_images(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Host-side staging transform (Workload.to_record)."""
+    out = dict(batch)
+    img = np.asarray(batch["image"])
+    out["image"] = np.clip(
+        np.rint(img * IMG_SCALE + IMG_OFFSET), 0, 255
+    ).astype(np.uint8)
+    return out
+
+
+def dequantize_images(batch):
+    """Device-side inverse (Workload.from_record), run inside the compiled
+    step; no-op for batches that never went through uint8 staging."""
+    img = batch["image"]
+    if img.dtype != jnp.uint8:
+        return batch
+    out = dict(batch)
+    out["image"] = (img.astype(jnp.float32) - IMG_OFFSET) * (1.0 / IMG_SCALE)
+    return out
+
 
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
@@ -190,4 +220,6 @@ def make_workload(
         make_optimizer=lambda schedule: optax.sgd(
             schedule, momentum=0.9, nesterov=True
         ),
+        to_record=quantize_images,
+        from_record=dequantize_images,
     )

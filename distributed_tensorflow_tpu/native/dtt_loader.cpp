@@ -42,8 +42,10 @@ class Loader {
  public:
   Loader(const char* path, uint64_t record_bytes, uint64_t batch_size,
          uint64_t shuffle, uint64_t num_threads, uint64_t prefetch,
-         uint64_t seed, uint64_t shard_index, uint64_t shard_count)
-      : record_bytes_(record_bytes),
+         uint64_t seed, uint64_t shard_index, uint64_t shard_count,
+         uint64_t header_bytes)
+      : header_bytes_(header_bytes),
+        record_bytes_(record_bytes),
         batch_size_(batch_size),
         shuffle_(shuffle != 0),
         prefetch_(prefetch < 1 ? 1 : prefetch),
@@ -53,13 +55,22 @@ class Loader {
     fd_ = open(path, O_RDONLY);
     if (fd_ < 0) { ok_ = false; return; }
     struct stat st;
-    if (fstat(fd_, &st) != 0 || st.st_size <= 0) { ok_ = false; return; }
+    if (fstat(fd_, &st) != 0 ||
+        st.st_size <= static_cast<off_t>(header_bytes_)) {
+      ok_ = false; return;
+    }
     file_bytes_ = static_cast<uint64_t>(st.st_size);
-    base_ = static_cast<const uint8_t*>(
+    map_ = static_cast<const uint8_t*>(
         mmap(nullptr, file_bytes_, PROT_READ, MAP_PRIVATE, fd_, 0));
-    if (base_ == MAP_FAILED) { base_ = nullptr; ok_ = false; return; }
-    madvise(const_cast<uint8_t*>(base_), file_bytes_, MADV_WILLNEED);
-    total_records_ = file_bytes_ / record_bytes_;
+    if (map_ == MAP_FAILED) { map_ = nullptr; ok_ = false; return; }
+    madvise(const_cast<uint8_t*>(map_), file_bytes_, MADV_WILLNEED);
+    // Data starts past the schema header (validated Python-side); reject a
+    // payload that is not a whole number of records — the symptom of a
+    // schema/file mismatch.
+    base_ = map_ + header_bytes_;
+    uint64_t payload = file_bytes_ - header_bytes_;
+    if (payload % record_bytes_ != 0) { ok_ = false; return; }
+    total_records_ = payload / record_bytes_;
     // this shard's record ids: i with i % shard_count == shard_index
     for (uint64_t i = shard_index_; i < total_records_; i += shard_count_) {
       shard_records_.push_back(i);
@@ -83,7 +94,7 @@ class Loader {
       cv_push_.notify_all();
     }
     for (auto& th : threads_) th.join();
-    if (base_) munmap(const_cast<uint8_t*>(base_), file_bytes_);
+    if (map_) munmap(const_cast<uint8_t*>(map_), file_bytes_);
     if (fd_ >= 0) close(fd_);
   }
 
@@ -152,9 +163,11 @@ class Loader {
   }
 
   int fd_ = -1;
-  const uint8_t* base_ = nullptr;
+  const uint8_t* map_ = nullptr;   // mmap base (whole file)
+  const uint8_t* base_ = nullptr;  // first record (past header)
   uint64_t file_bytes_ = 0;
   uint64_t total_records_ = 0;
+  uint64_t header_bytes_;
   uint64_t record_bytes_, batch_size_;
   bool shuffle_;
   uint64_t prefetch_, seed_, shard_index_, shard_count_;
@@ -179,9 +192,10 @@ void* dtt_loader_create(const char* path, uint64_t record_bytes,
                         uint64_t batch_size, uint64_t shuffle,
                         uint64_t num_threads, uint64_t prefetch,
                         uint64_t seed, uint64_t shard_index,
-                        uint64_t shard_count) {
+                        uint64_t shard_count, uint64_t header_bytes) {
   Loader* l = new Loader(path, record_bytes, batch_size, shuffle, num_threads,
-                         prefetch, seed, shard_index, shard_count);
+                         prefetch, seed, shard_index, shard_count,
+                         header_bytes);
   if (!l->ok()) {
     delete l;
     return nullptr;

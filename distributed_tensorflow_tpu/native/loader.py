@@ -66,7 +66,7 @@ def _load_library() -> Optional[ctypes.CDLL]:
             return None
         lib.dtt_loader_create.restype = ctypes.c_void_p
         lib.dtt_loader_create.argtypes = [
-            ctypes.c_char_p] + [ctypes.c_uint64] * 8
+            ctypes.c_char_p] + [ctypes.c_uint64] * 9
         lib.dtt_loader_num_records.restype = ctypes.c_uint64
         lib.dtt_loader_num_records.argtypes = [ctypes.c_void_p]
         lib.dtt_loader_next.restype = ctypes.c_int
@@ -83,12 +83,20 @@ def native_available() -> bool:
     return _load_library() is not None
 
 
+RECORD_MAGIC = b"DTTREC01"
+RECORD_HEADER_BYTES = 16  # magic (8) + record_bytes u64 LE
+
+
 class RecordFile:
     """Fixed-size-record file: the loader's on-disk format.
 
     A record is one example: the concatenation of each field's fixed-size
-    little-endian buffer.  ``write()`` stages numpy batches into the format;
-    training jobs usually write once (or convert) and read many times.
+    little-endian buffer.  The file starts with a 16-byte header (magic +
+    record_bytes) so a schema change — e.g. the uint8 image staging that
+    quartered the resnet50 record — makes stale files fail LOUDLY instead
+    of being reinterpreted as garbage.  ``write()`` stages numpy batches
+    into the format; training jobs usually write once (or convert) and
+    read many times.
     """
 
     def __init__(self, fields: Sequence[Tuple[str, Tuple[int, ...], np.dtype]]):
@@ -97,12 +105,45 @@ class RecordFile:
             int(np.prod(s)) * d.itemsize for _, s, d in self.fields
         )
 
+    def header(self) -> bytes:
+        import struct
+
+        return RECORD_MAGIC + struct.pack("<Q", self.record_bytes)
+
+    def check_header(self, path: str) -> None:
+        """Raise if ``path`` was not written with this schema."""
+        import struct
+
+        with open(path, "rb") as f:
+            hdr = f.read(RECORD_HEADER_BYTES)
+        if len(hdr) < RECORD_HEADER_BYTES or hdr[:8] != RECORD_MAGIC:
+            raise ValueError(
+                f"{path!r} is not a DTTREC01 record file (headerless or "
+                "foreign format); re-stage it with RecordFile.write / "
+                "stage_synthetic_to_records / convert_tfrecords"
+            )
+        (rb,) = struct.unpack("<Q", hdr[8:16])
+        if rb != self.record_bytes:
+            raise ValueError(
+                f"{path!r} holds {rb}-byte records but this schema expects "
+                f"{self.record_bytes} bytes — the staging format changed "
+                "(e.g. uint8 image staging); re-stage the file"
+            )
+
+    def file_size(self, num_records: int) -> int:
+        """On-disk size of a file holding ``num_records`` records."""
+        return RECORD_HEADER_BYTES + num_records * self.record_bytes
+
     def write(self, path: str, arrays: dict, *, append: bool = False) -> int:
         ns = {len(arrays[n]) for n, _, _ in self.fields}
         assert len(ns) == 1, "all fields must have the same leading dim"
         n = ns.pop()
+        if append:
+            self.check_header(path)
         mode = "ab" if append else "wb"
         with open(path, mode) as f:
+            if not append:
+                f.write(self.header())
             for i in range(n):
                 for name, shape, dtype in self.fields:
                     a = np.asarray(arrays[name][i], dtype=dtype)
@@ -160,26 +201,37 @@ class NativeRecordLoader:
         self._out = np.empty(
             (batch_size, record.record_bytes), dtype=np.uint8
         )
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no record file at {path!r}")
+        # Schema guard: fail loudly on headerless/stale files instead of
+        # reinterpreting their bytes under a changed record format.
+        record.check_header(path)
         if self._lib is not None:
             self._handle = self._lib.dtt_loader_create(
                 path.encode(), record.record_bytes, batch_size,
                 int(shuffle), num_threads, prefetch, seed,
                 self._shard_index, self._shard_count,
+                RECORD_HEADER_BYTES,
             )
             if not self._handle:
                 raise FileNotFoundError(
                     f"native loader could not open {path!r} (missing, empty, "
-                    f"or shard {self._shard_index}/{self._shard_count} holds "
-                    "no records)"
+                    f"truncated payload, or shard {self._shard_index}/"
+                    f"{self._shard_count} holds no records)"
                 )
             self.num_records = int(
                 self._lib.dtt_loader_num_records(self._handle)
             )
         else:
-            data = np.fromfile(path, dtype=np.uint8)
+            data = np.fromfile(path, dtype=np.uint8)[RECORD_HEADER_BYTES:]
             n = data.size // record.record_bytes
             if n == 0:
                 raise FileNotFoundError(f"no records in {path!r}")
+            if data.size % record.record_bytes:
+                raise ValueError(
+                    f"{path!r}: payload is not a whole number of "
+                    f"{record.record_bytes}-byte records — schema mismatch"
+                )
             data = data[: n * record.record_bytes].reshape(
                 n, record.record_bytes
             )

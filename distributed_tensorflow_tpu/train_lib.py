@@ -127,6 +127,17 @@ def parse_args(argv=None) -> TrainArgs:
     return TrainArgs(**vars(ns))
 
 
+def _wrap_from_record(workload: Workload, fn):
+    """Apply the workload's device-side staging inverse (from_record) to
+    the batch before the loss — inside the compiled step, so uint8-staged
+    inputs dequantize on device (no-op for unstaged batches)."""
+    if workload.from_record is None or fn is None:
+        return fn
+    if workload.stateful:
+        return lambda p, ms, b, rng: fn(p, ms, workload.from_record(b), rng)
+    return lambda p, b, rng: fn(p, workload.from_record(b), rng)
+
+
 def build_state_and_step(
     workload: Workload,
     mesh,
@@ -171,7 +182,7 @@ def build_state_and_step(
     state = jax.jit(init_fn, out_shardings=state_shardings)()
 
     raw_step = make_train_step(
-        workload.loss_fn,
+        _wrap_from_record(workload, workload.loss_fn),
         grad_accum_steps=grad_accum_steps,
         precision=precision,
         clip_grad_norm=workload.clip_grad_norm,
@@ -352,7 +363,7 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         hooks.append(MetricsFileWriter(args.metrics_file))
     if args.eval_every > 0:
         eval_step = make_eval_step(
-            workload.eval_loss_fn or workload.loss_fn,
+            _wrap_from_record(workload, workload.eval_loss_fn or workload.loss_fn),
             precision=precision, stateful=workload.stateful,
         )
         eval_iter = make_eval_data(workload, batch_shardings)
@@ -430,7 +441,7 @@ def run_evaluator(args: TrainArgs) -> Dict[str, Any]:
     )
     manager = CheckpointManager(args.checkpoint_dir, save_interval_steps=1)
     eval_step = make_eval_step(
-        workload.eval_loss_fn or workload.loss_fn,
+        _wrap_from_record(workload, workload.eval_loss_fn or workload.loss_fn),
         precision=precision, stateful=workload.stateful,
     )
     eval_iter = make_eval_data(workload, batch_shardings)

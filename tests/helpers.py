@@ -1,0 +1,28 @@
+"""Shared test utilities (imported, not collected — no test_ prefix)."""
+
+import socket
+from typing import List
+
+
+def free_ports(n: int) -> List[int]:
+    """Allocate ``n`` distinct free localhost ports.
+
+    All sockets stay open until every port is bound, so two calls cannot
+    be handed the same just-released ephemeral port (the p0 == p1 race a
+    close-then-rebind helper has).
+    """
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def free_port() -> int:
+    return free_ports(1)[0]

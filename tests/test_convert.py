@@ -1,0 +1,156 @@
+"""TFRecord → RecordFile converter tests (real-dataset ingestion for
+--data_dir; VERDICT missing #7).  Writes genuine TFRecord files with
+TensorFlow's writer, converts them, and trains through the native loader.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from distributed_tensorflow_tpu.data.convert import (  # noqa: E402
+    convert_tfrecords,
+    iter_tfrecord,
+    parse_example,
+)
+from distributed_tensorflow_tpu.data.records import record_path  # noqa: E402
+from distributed_tensorflow_tpu.models import get_workload  # noqa: E402
+
+
+def _write_tfrecord(path, examples):
+    with tf.io.TFRecordWriter(str(path)) as w:
+        for ex in examples:
+            feats = {}
+            for name, val in ex.items():
+                val = np.asarray(val)
+                if val.dtype.kind == "f":
+                    feats[name] = tf.train.Feature(
+                        float_list=tf.train.FloatList(value=val.ravel())
+                    )
+                else:
+                    feats[name] = tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=val.ravel())
+                    )
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feats)
+            ).SerializeToString())
+
+
+def test_iter_and_parse_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    examples = [
+        {"x": rng.randn(4).astype(np.float32), "y": np.int64(i)}
+        for i in range(10)
+    ]
+    p = tmp_path / "a.tfrecord"
+    _write_tfrecord(p, examples)
+    got = [parse_example(buf) for buf in iter_tfrecord(str(p))]
+    assert len(got) == 10
+    for ex, g in zip(examples, got):
+        np.testing.assert_allclose(g["x"], ex["x"], rtol=1e-6)
+        assert g["y"][0] == ex["y"]
+
+
+def test_convert_then_train_mnist(tmp_path):
+    """Full ingestion path: TFRecord shards -> RecordFile -> native loader
+    -> training (loss finite)."""
+    from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+    wl = get_workload("mnist", batch_size=16)
+    rng = np.random.RandomState(1)
+    n = 128
+    shard_a = [
+        {"image": rng.randn(28, 28, 1).astype(np.float32),
+         "label": np.int64(rng.randint(10))}
+        for _ in range(n // 2)
+    ]
+    shard_b = [
+        {"image": rng.randn(28, 28, 1).astype(np.float32),
+         "label": np.int64(rng.randint(10))}
+        for _ in range(n // 2)
+    ]
+    _write_tfrecord(tmp_path / "train-00000", shard_a)
+    _write_tfrecord(tmp_path / "train-00001", shard_b)
+
+    def transform(ex):
+        return {
+            "image": ex["image"].reshape(28, 28, 1).astype(np.float32),
+            "label": ex["label"].astype(np.int32)[0],
+        }
+
+    out = record_path(str(tmp_path / "staged"), "mnist")
+    wrote = convert_tfrecords(
+        [str(tmp_path / "train-00000"), str(tmp_path / "train-00001")],
+        out, workload=wl, transform=transform,
+    )
+    assert wrote == n
+
+    result = run(TrainArgs(
+        model="mnist", steps=6, batch_size=16, log_every=3,
+        data_dir=str(tmp_path / "staged"),
+    ))
+    assert result["final_step"] == 6
+    assert np.isfinite(result["loss"])
+
+
+def test_convert_applies_to_record_staging(tmp_path):
+    """Workload.to_record (uint8 image staging) applies during conversion:
+    resnet records land quantized on disk."""
+    from distributed_tensorflow_tpu.data.records import record_schema
+
+    wl = get_workload("resnet50", batch_size=8, num_classes=4,
+                      image_size=8, stage_sizes=(1, 1, 1, 1))
+    rng = np.random.RandomState(2)
+    exs = [
+        {"image": rng.randn(8, 8, 3).astype(np.float32),
+         "label": np.int64(rng.randint(4))}
+        for _ in range(32)
+    ]
+    p = tmp_path / "rn.tfrecord"
+    _write_tfrecord(p, exs)
+
+    def transform(ex):
+        return {
+            "image": ex["image"].reshape(8, 8, 3).astype(np.float32),
+            "label": ex["label"].astype(np.int32)[0],
+        }
+
+    out = record_path(str(tmp_path / "staged"), "resnet50")
+    wrote = convert_tfrecords([str(p)], out, workload=wl, transform=transform)
+    assert wrote == 32
+    schema = record_schema(wl)
+    import os
+
+    assert os.path.getsize(out) == schema.file_size(32)
+    # image field staged as uint8 (quarter the f32 size)
+    dtypes = {n: d for n, _, d in schema.fields}
+    assert dtypes["image"] == np.uint8
+
+
+def test_limit_and_missing_field_error(tmp_path):
+    wl = get_workload("mnist", batch_size=8)
+    rng = np.random.RandomState(3)
+    exs = [
+        {"image": rng.randn(28, 28, 1).astype(np.float32),
+         "label": np.int64(1)}
+        for _ in range(20)
+    ]
+    p = tmp_path / "m.tfrecord"
+    _write_tfrecord(p, exs)
+
+    def transform(ex):
+        return {
+            "image": ex["image"].reshape(28, 28, 1).astype(np.float32),
+            "label": ex["label"].astype(np.int32)[0],
+        }
+
+    out = record_path(str(tmp_path / "staged"), "mnist")
+    wrote = convert_tfrecords([str(p)], out, workload=wl,
+                              transform=transform, limit=12)
+    assert wrote == 12
+
+    # an example stream missing a schema field is a hard error
+    p2 = tmp_path / "nolabel.tfrecord"
+    _write_tfrecord(p2, [{"image": np.zeros(784, np.float32)}])
+    with pytest.raises(ValueError, match="lacks schema fields"):
+        convert_tfrecords([str(p2)], str(tmp_path / "bad.rec"), workload=wl)

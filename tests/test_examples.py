@@ -14,16 +14,11 @@ import sys
 
 import pytest
 
+from tests.helpers import free_ports
+
 REPO = os.path.dirname(os.path.dirname(__file__))
 LAUNCHER = os.path.join(REPO, "examples", "tf1_ps_launcher.py")
 
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _env():
@@ -61,7 +56,7 @@ def test_tf1_ps_launcher_ps_and_worker(tmp_path):
     """Reference cluster mode: a real ps process parks in Server.join() while
     the worker trains; worker completion terminates the ps (launcher
     contract, SURVEY.md §4.2)."""
-    ps_port, w_port = _free_port(), _free_port()
+    ps_port, w_port = free_ports(2)
     common = [
         "--ps_hosts", f"localhost:{ps_port}",
         "--worker_hosts", f"localhost:{w_port}",

@@ -14,6 +14,8 @@ import numpy as np
 import optax
 import pytest
 
+from tests.helpers import free_ports
+
 from distributed_tensorflow_tpu.checkpoint import CheckpointManager
 from distributed_tensorflow_tpu.ft import (
     HealthChecker,
@@ -75,6 +77,134 @@ class TestPreemptionCheckpointHook:
         restored = mgr.restore_or_init(state2)
         assert int(jax.device_get(restored.step)) == 10
         mgr.close()
+
+
+PSM_SCRIPT = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.ft import (
+    PreemptionCheckpointHook, PreemptionWatcher, TerminationConfig,
+)
+from distributed_tensorflow_tpu.training import FP32, TrainLoop, make_train_step
+from tests.test_training import linear_batch, make_linear_state, quadratic_loss
+
+resolver = cluster_lib.resolve()
+server = cluster_lib.Server.from_resolver(resolver)
+assert jax.process_count() == 2
+
+
+class RecordingManager:
+    # The real orbax save path is covered elsewhere (multihost save of a
+    # process-local test state is an orbax no-go); THIS test asserts the
+    # notice propagation + step agreement.
+    def __init__(self):
+        self.saved = []
+
+    def save(self, step, state, force=False):
+        self.saved.append(step)
+
+    def wait_until_finished(self):
+        pass
+
+
+mgr = RecordingManager()
+# Watcher listens to NO signals: SIGTERM must flow through the JAX
+# preemption sync manager (the platform-notice path under test).
+watcher = PreemptionWatcher(TerminationConfig(signals=())).install()
+hook = PreemptionCheckpointHook(mgr, watcher, sync_every=10_000)
+
+state = make_linear_state()
+step = make_train_step(quadratic_loss, precision=FP32)
+marker = os.path.join(sys.argv[1], f"training{jax.process_index()}")
+
+
+class Slow:
+    def __init__(self):
+        self.n = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.n += 1
+        if self.n == 30:  # both workers well into training -> safe to signal
+            open(marker, "w").close()
+        time.sleep(0.05)
+        return linear_batch()
+
+
+print("PSM_TRAIN_READY", flush=True)
+loop = TrainLoop(step, state, Slow(), hooks=[hook], metrics_every=1)
+final = loop.run(4000)
+stopped = int(jax.device_get(final.step))
+assert hook.handled, "hook never saw the platform preemption notice"
+assert mgr.saved and mgr.saved[-1] == stopped
+print("PSM_STOPPED_AT", stopped, flush=True)
+os._exit(0)
+"""
+
+
+
+def test_platform_preemption_notice_stops_both_workers(tmp_path):
+    """SIGTERM to ONE worker propagates through JAX's preemption sync
+    manager (not our signal watcher — it listens to no signals here) and
+    both workers checkpoint and stop at the SAME agreed step (SURVEY.md
+    §6.3 platform-notice path; VERDICT missing #6)."""
+    import json
+
+    p0, p1 = free_ports(2)
+    cluster = {"worker": [f"localhost:{p0}", f"localhost:{p1}"]}
+    procs = []
+    for idx in range(2):
+        env = dict(
+            os.environ,
+            TF_CONFIG=json.dumps(
+                {"cluster": cluster, "task": {"type": "worker", "index": idx}}
+            ),
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", PSM_SCRIPT, str(tmp_path)],
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    try:
+        deadline = time.time() + 120
+        # wait until BOTH workers are ~30 steps into training (marker files)
+        # before delivering the notice: the runtime's preemption notifier
+        # must be fully up or the signal is lost.
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(str(tmp_path), f"training{i}"))
+                   for i in range(2)):
+                break
+            time.sleep(0.5)
+        else:
+            for q in procs:
+                q.kill()
+            pytest.fail("workers never reached training")
+        time.sleep(8.0)
+        procs[1].send_signal(signal.SIGTERM)  # scheduler preempts worker 1
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        pytest.fail("workers hung after platform preemption notice")
+    steps = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-4000:]}"
+        assert "PSM_STOPPED_AT" in out, out[-2000:]
+        steps.append(int(out.split("PSM_STOPPED_AT")[1].split()[0]))
+    assert steps[0] == steps[1], f"workers stopped at different steps {steps}"
 
 
 class TestHealthChecker:

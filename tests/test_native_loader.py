@@ -160,12 +160,99 @@ class TestLoader:
         )
         loader.close()
 
+    def test_schema_guard_rejects_stale_or_foreign_files(self, record, tmp_path):
+        """A record-format change (e.g. uint8 staging) must fail LOUDLY on
+        old files instead of reinterpreting their bytes (review r2)."""
+        n = 8
+        rng = np.random.RandomState(0)
+        arrays = {
+            "image": rng.randn(n, 4, 4, 1).astype(np.float32),
+            "label": np.arange(n, dtype=np.int32),
+        }
+        path = str(tmp_path / "ok.rec")
+        record.write(path, arrays)
+        # (a) same file, different schema (changed record size) -> rejected
+        other = RecordFile([("image", (4, 4, 1), np.uint8),
+                            ("label", (), np.int32)])
+        with pytest.raises(ValueError, match="staging format changed|expects"):
+            NativeRecordLoader(path, other, batch_size=2,
+                               shard_index=0, shard_count=1)
+        # (b) headerless/foreign file -> rejected
+        raw = str(tmp_path / "raw.bin")
+        with open(raw, "wb") as f:
+            f.write(b"\0" * (record.record_bytes * 4))
+        with pytest.raises(ValueError, match="not a DTTREC01"):
+            NativeRecordLoader(raw, record, batch_size=2,
+                               shard_index=0, shard_count=1)
+        # (c) append with a mismatched schema -> rejected before writing
+        with pytest.raises(ValueError):
+            other.write(path, {"image": arrays["image"].astype(np.uint8),
+                               "label": arrays["label"]}, append=True)
+
     def test_missing_file_raises(self, record, tmp_path):
         with pytest.raises(FileNotFoundError):
             NativeRecordLoader(
                 str(tmp_path / "nope.rec"), record, batch_size=4,
                 shard_index=0, shard_count=1,
             )
+
+
+class TestUint8Staging:
+    def test_quantize_roundtrip(self):
+        from distributed_tensorflow_tpu.models.resnet import (
+            IMG_OFFSET,
+            IMG_SCALE,
+            quantize_images,
+        )
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4, 4, 3).astype(np.float32)
+        q = quantize_images({"image": x, "label": np.zeros(8)})["image"]
+        assert q.dtype == np.uint8
+        back = (q.astype(np.float32) - IMG_OFFSET) / IMG_SCALE
+        # quantization error bounded by half a step within the u8 range
+        clipped = np.clip(x, -IMG_OFFSET / IMG_SCALE, (255 - IMG_OFFSET) / IMG_SCALE)
+        np.testing.assert_allclose(back, clipped, atol=0.5 / IMG_SCALE + 1e-6)
+
+    def test_resnet_schema_is_uint8_and_trains(self, tmp_path, mesh_dp):
+        """Stage→load→train through the uint8 path: records are 1/4 size,
+        and from_record dequantizes on device inside the compiled step."""
+        import jax
+        from distributed_tensorflow_tpu.data.pipeline import (
+            make_global_batches,
+        )
+        from distributed_tensorflow_tpu.data.records import (
+            record_data_fn,
+            record_path,
+            record_schema,
+            stage_synthetic_to_records,
+        )
+        from distributed_tensorflow_tpu.models import get_workload
+        from distributed_tensorflow_tpu.train_lib import build_state_and_step
+
+        wl = get_workload("resnet50", batch_size=8, num_classes=4,
+                          image_size=32, stage_sizes=(1, 1, 1, 1))
+        schema = record_schema(wl)
+        img_field = dict((n, d) for n, _, d in schema.fields)["image"]
+        assert img_field == np.uint8
+        path = record_path(str(tmp_path), "resnet50")
+        stage_synthetic_to_records(wl, path, 64)
+        assert os.path.getsize(path) == schema.file_size(64)
+
+        state, _, train_step, batch_sh = build_state_and_step(
+            wl, mesh_dp, total_steps=4,
+        )
+        data = make_global_batches(
+            record_data_fn(path, wl, num_threads=1)(8),
+            batch_sh[wl.example_key],
+        )
+        rng = jax.random.key(0)
+        losses = []
+        for i, batch in zip(range(4), data):
+            assert batch["image"].dtype == np.uint8  # staged form on device
+            state, m = train_step(state, batch, jax.random.fold_in(rng, i))
+            losses.append(float(m["loss"]))
+        assert np.isfinite(losses).all()
 
 
 class TestRecordTrainingPath:
@@ -185,7 +272,7 @@ class TestRecordTrainingPath:
         n = stage_synthetic_to_records(wl, path, 256)
         assert n == 256
         schema = record_schema(wl)
-        assert os.path.getsize(path) == 256 * schema.record_bytes
+        assert os.path.getsize(path) == schema.file_size(256)
 
         result = run(TrainArgs(
             model="mnist", steps=10, batch_size=32, log_every=5,

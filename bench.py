@@ -116,7 +116,7 @@ def main(argv=None):
         if os.path.exists(baseline_file):
             with open(baseline_file) as f:
                 recorded = json.load(f)
-            if recorded.get("value"):
+            if recorded.get("unit") == "images/sec/chip" and recorded.get("value"):
                 vs_baseline = per_chip / float(recorded["value"])
     elif on_tpu:
         if os.path.exists(baseline_file):

@@ -122,7 +122,6 @@ def convert_tfrecords(
     written = 0
     first = True
     batch: Dict[str, list] = {n: [] for n in staged_fields}
-    raw_names = None
 
     def flush():
         nonlocal written, first

@@ -161,6 +161,9 @@ class DataServiceServer:
             with self._conns_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                me = threading.current_thread()
+                if me in self._threads:
+                    self._threads.remove(me)
 
     def stop(self) -> None:
         self._stop.set()
@@ -206,6 +209,9 @@ class DataServiceIterator:
         self._sock = socket.create_connection((host, int(port)), timeout=60)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         rec_bytes, srv_bs = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
+        # The 60s timeout covers connect+handshake only; batches may
+        # legitimately take longer on a contended input host — block.
+        self._sock.settimeout(None)
         if rec_bytes != record.record_bytes:
             raise ValueError(
                 f"data service at {address} serves {rec_bytes}-byte records "

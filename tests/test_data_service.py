@@ -58,8 +58,11 @@ class TestDataService:
         """Two consumers never see the same batch (distributed_epoch
         semantics): one epoch of batches is partitioned across them."""
         path, rec, _ = indexed_record
+        # num_threads=1: multi-thread producers can push batches out of
+        # epoch-draw order, which would make the strict one-epoch
+        # disjointness below racy; stream-splitting is what's under test.
         server = DataServiceServer(path, rec, batch_size=16,
-                                   shuffle=True, num_threads=2).start()
+                                   shuffle=True, num_threads=1).start()
         try:
             a = DataServiceIterator(server.target, rec, 16)
             b = DataServiceIterator(server.target, rec, 16)

@@ -119,13 +119,13 @@ class DataServiceServer:
                 continue
             except OSError:
                 break
-            with self._conns_lock:
-                self._conns.append(conn)
             t = threading.Thread(
                 target=self._serve_one, args=(conn, addr), daemon=True
             )
+            with self._conns_lock:
+                self._conns.append(conn)
+                self._threads.append(t)
             t.start()
-            self._threads.append(t)
 
     def _serve_one(self, conn: socket.socket, addr) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -181,7 +181,9 @@ class DataServiceServer:
                     conn.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
-        for t in self._threads:
+        with self._conns_lock:
+            threads = list(self._threads)  # serve threads remove themselves
+        for t in threads:
             t.join(timeout=5)
         # Under the loader lock: a serve thread may be inside next_raw();
         # destroying the native handle out from under it would be a

@@ -5,8 +5,11 @@ Run: python scripts/validate_tpu.py   (needs the axon TPU; not a pytest —
 the pytest suite pins JAX to the virtual CPU mesh.)
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -51,6 +54,26 @@ def main():
     print(f"bf16 causal: max_abs_err={errb:.3e}")
     assert errb < 3e-2, errb
 
+    # Gradient parity: the Pallas dq/dk/dv kernels vs XLA autodiff of the
+    # dense formulation (bf16 production dtype, causal).
+    def loss_flash(a, b, c):
+        return flash_attention(a, b, c, causal=True).astype(jnp.float32).sum()
+
+    def loss_dense(a, b, c):
+        return _dense(a, b, c, causal=True,
+                      scale=1 / np.sqrt(D)).astype(jnp.float32).sum()
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(qb, kb, vb)
+    g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(qb, kb, vb)
+    for nm, gf, gd in zip("qkv", g_flash, g_dense):
+        gf32 = gf.astype(jnp.float32)
+        gd32 = gd.astype(jnp.float32)
+        # relative to the gradient scale (sums over T accumulate magnitude)
+        denom = float(jnp.max(jnp.abs(gd32))) or 1.0
+        rel = float(jnp.max(jnp.abs(gf32 - gd32))) / denom
+        print(f"grad d{nm}: max_rel_err={rel:.3e}")
+        assert rel < 5e-2, (nm, rel)
+
     for name, fn in (("flash", f_flash), ("dense", f_dense)):
         fn(qb, kb, vb).block_until_ready()
         t0 = time.perf_counter()
@@ -60,7 +83,9 @@ def main():
         dt = (time.perf_counter() - t0) / 20
         flops = 4 * B * H * T * T * D / 2  # causal half
         print(f"{name}: {dt * 1e3:.2f} ms/iter  "
-              f"{flops / dt / 1e12:.2f} TFLOP/s")
+              f"{flops / dt / 1e12:.2f} TFLOP/s "
+              "(wall-clock incl. dispatch latency; see profile_resnet.py "
+              "for device-time methodology)")
 
     print("TPU validation OK")
 

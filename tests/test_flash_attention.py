@@ -33,8 +33,16 @@ class TestFlashAttention:
         )
 
     def test_multi_block_causal(self, monkeypatch):
-        # T=512 -> 4 q-blocks x 4 k-blocks; exercises the block skip logic
+        # T=512 with 128-blocks -> 4 q-blocks x 4 k-blocks; exercises the
+        # causal block-skip bounds and the online-softmax rescale (blocks
+        # pinned: the production default 512 would clamp to single-block)
         monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa_mod, "BLOCK_Q", 128)
+        monkeypatch.setattr(fa_mod, "BLOCK_K", 128)
         from distributed_tensorflow_tpu.ops import flash_attention
         from distributed_tensorflow_tpu.ops.flash_attention import _dense
 
@@ -44,6 +52,21 @@ class TestFlashAttention:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
         )
+
+    def test_fit_block_keeps_128_multiples_supported(self):
+        """Raising the default blocks to 512 must not drop seq lens that
+        are multiples of 128 but not 512 (640/768/1152...) off the flash
+        path — _fit_block falls back to the largest dividing block."""
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        assert fa_mod._fit_block(1024, 512) == 512
+        assert fa_mod._fit_block(768, 512) == 384
+        assert fa_mod._fit_block(640, 512) == 128
+        assert fa_mod._fit_block(1152, 512) == 384
+        assert fa_mod._fit_block(96, 512) == 96  # T <= want: whole seq
+        assert fa_mod._fit_block(130, 512) is None  # no 128-divisor
 
     def test_cpu_fallback_without_interpret(self, monkeypatch):
         monkeypatch.delenv("DTT_PALLAS_INTERPRET", raising=False)
@@ -76,8 +99,15 @@ class TestFlashAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_fused_backward_matches_dense(self, monkeypatch, causal):
         """dq/dk/dv from the Pallas backward kernels vs XLA autodiff of the
-        dense formulation — multi-block (T=384 -> 3x3 tiles)."""
+        dense formulation — multi-block (T=384 -> 3x3 128-tiles; blocks
+        pinned so the fori_loop bounds and accumulators really iterate)."""
         monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa_mod, "BLOCK_Q", 128)
+        monkeypatch.setattr(fa_mod, "BLOCK_K", 128)
         from distributed_tensorflow_tpu.ops import flash_attention
         from distributed_tensorflow_tpu.ops.flash_attention import _dense
 
@@ -104,6 +134,12 @@ class TestFlashAttention:
         """bf16 inputs (the training dtype): kernels accumulate f32, so the
         result should track the dense-bf16 path within bf16 tolerance."""
         monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa_mod, "BLOCK_Q", 128)
+        monkeypatch.setattr(fa_mod, "BLOCK_K", 128)
         from distributed_tensorflow_tpu.ops import flash_attention
         from distributed_tensorflow_tpu.ops.flash_attention import _dense
 

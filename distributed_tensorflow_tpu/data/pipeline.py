@@ -167,6 +167,12 @@ def synthetic_lm(
         yield {"tokens": tokens.astype(np.int32)}
 
 
+def mlm_max_predictions(seq_len: int, mask_rate: float = 0.15) -> int:
+    """The reference's ``max_predictions_per_seq``: fixed prediction-slot
+    count so the MLM head runs on a static (B, K) gather, not (B, T)."""
+    return max(1, int(seq_len * mask_rate))
+
+
 def synthetic_mlm(
     *,
     batch_size: int,
@@ -180,12 +186,17 @@ def synthetic_mlm(
     """BERT-pretraining-style stream: masked tokens + segment ids + NSP label.
 
     Tokens have the same local structure as ``synthetic_lm`` so MLM is
-    learnable; the NSP label marks whether the second segment continues the
-    first sequence or is an independent draw.
+    learnable.  Masked positions use the reference's
+    ``max_predictions_per_seq`` wire format — exactly K =
+    ``mlm_max_predictions(seq_len)`` prediction slots per example
+    (``mlm_positions``/``mlm_targets``/``mlm_weights`` of shape (B, K)) —
+    so the model's MLM head gathers K positions instead of projecting all
+    T positions to the vocabulary.
     """
     num_shards, index = shard_options()
     rng = np.random.RandomState(seed * 3001 + index + (500_009 if holdout else 0))
     half = seq_len // 2
+    K = mlm_max_predictions(seq_len, mask_rate)
     while True:
         start = rng.randint(2, vocab_size, size=(batch_size, 1))
         steps = rng.randint(1, 7, size=(batch_size, seq_len))
@@ -201,12 +212,18 @@ def synthetic_mlm(
             [np.zeros((batch_size, half)), np.ones((batch_size, seq_len - half))],
             axis=1,
         )
-        mlm_mask = (rng.rand(batch_size, seq_len) < mask_rate)
-        masked = np.where(mlm_mask, mask_token, tokens)
+        # K distinct masked positions per example (first K of a permutation)
+        positions = np.argsort(
+            rng.rand(batch_size, seq_len), axis=1
+        )[:, :K].astype(np.int32)
+        targets = np.take_along_axis(tokens, positions, axis=1)
+        masked = tokens.copy()
+        np.put_along_axis(masked, positions, mask_token, axis=1)
         yield {
             "tokens": masked.astype(np.int32),
-            "mlm_targets": tokens.astype(np.int32),
-            "mlm_mask": mlm_mask.astype(np.float32),
+            "mlm_positions": positions,
+            "mlm_targets": targets.astype(np.int32),
+            "mlm_weights": np.ones((batch_size, K), np.float32),
             "segment_ids": segment_ids.astype(np.int32),
             "nsp_label": nsp.astype(np.int32),
         }

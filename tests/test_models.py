@@ -288,8 +288,9 @@ class TestBert:
         module = BertPretrain(BertConfig.base())
         batch = {
             "tokens": np.zeros((1, 8), np.int32),
-            "mlm_targets": np.zeros((1, 8), np.int32),
-            "mlm_mask": np.zeros((1, 8), np.float32),
+            "mlm_positions": np.zeros((1, 2), np.int32),
+            "mlm_targets": np.zeros((1, 2), np.int32),
+            "mlm_weights": np.zeros((1, 2), np.float32),
             "segment_ids": np.zeros((1, 8), np.int32),
             "nsp_label": np.zeros((1,), np.int32),
         }

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 from typing import Any
 
 import jax
@@ -54,7 +55,14 @@ def broadcast_from_coordinator(value: Any) -> Any:
 
 
 def fingerprint(obj: Any) -> str:
-    """Stable hash of a jsonable/pytree-of-shapes object."""
+    """Stable hash of a jsonable/pytree-of-shapes object.
+
+    Process-local artifacts in reprs are scrubbed: a pytree's treedef
+    string embeds static fields whose reprs contain memory addresses
+    (``<function train_step at 0x7f...>``) that differ per process —
+    without scrubbing, identical programs would fingerprint differently
+    on every host and the guard would always trip.
+    """
 
     def _canon(x):
         if isinstance(x, (np.ndarray, jax.Array)):
@@ -67,6 +75,7 @@ def fingerprint(obj: Any) -> str:
     payload = json.dumps(
         [str(treedef)] + [repr(_canon(l)) for l in leaves], sort_keys=True
     )
+    payload = re.sub(r"0x[0-9a-fA-F]+", "0x", payload)
     return hashlib.sha256(payload.encode()).hexdigest()
 
 

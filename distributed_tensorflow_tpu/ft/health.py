@@ -150,20 +150,32 @@ class HealthChecker:
 
 
 class HealthCheckHook:
-    """Training-loop hook running a ``HealthChecker`` for the duration of the
-    loop: started at ``begin``, consulted at every step boundary (the worker
+    """Training-loop hook running a ``HealthChecker``: armed after the FIRST
+    step completes, consulted at every later step boundary (the worker
     raises instead of hanging in a collective whose peer died — MWMS's
     check-health thread behavior, $TF collective_all_reduce_strategy.py:340),
     stopped at ``end``.
+
+    Arming at step 1 — not at loop begin — matters: the first step is
+    itself a cluster-wide collective, so its completion proves every peer
+    is up and compiled.  Starting probes at loop begin false-positives on
+    skewed startup (a peer still compiling misses ``failures_before_action``
+    probe barriers and a HEALTHY run gets killed — observed with two
+    workers sharing one host core, where compiles serialize).
     """
 
     def __init__(self, checker: Optional[HealthChecker] = None, **kw):
         self.checker = checker or HealthChecker(**kw)
+        self._armed = False
 
-    def begin(self, loop) -> None:
-        self.checker.start()
+    def begin(self, loop) -> None:  # arming happens at the first step
+        pass
 
     def after_step(self, loop, step, metrics) -> None:
+        if not self._armed:
+            self._armed = True
+            self.checker.start()
+            return
         self.checker.raise_if_unhealthy()
 
     def end(self, loop, step) -> None:

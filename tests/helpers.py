@@ -1,7 +1,11 @@
 """Shared test utilities (imported, not collected — no test_ prefix)."""
 
+import json
+import os
 import socket
-from typing import List
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
 
 
 def free_ports(n: int) -> List[int]:
@@ -26,3 +30,56 @@ def free_ports(n: int) -> List[int]:
 
 def free_port() -> int:
     return free_ports(1)[0]
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_worker_cluster(
+    script: str,
+    n: int = 2,
+    *,
+    args: Sequence[str] = (),
+    extra_env: Optional[Dict[str, str]] = None,
+) -> List[subprocess.Popen]:
+    """Start ``n`` worker processes forming a localhost TF_CONFIG cluster.
+
+    Each runs ``script`` via ``python -c`` with JAX pinned to CPU and the
+    axon TPU pool disabled — the shared bootstrap contract of every
+    multiprocess test.
+    """
+    ports = free_ports(n)
+    cluster = {"worker": [f"localhost:{p}" for p in ports]}
+    procs = []
+    for idx in range(n):
+        env = dict(
+            os.environ,
+            TF_CONFIG=json.dumps(
+                {"cluster": cluster, "task": {"type": "worker", "index": idx}}
+            ),
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+        )
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script, *args],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    return procs
+
+
+def join_workers(procs, *, timeout: int, fail) -> List[str]:
+    """communicate() every worker; on any timeout kill ALL and call
+    ``fail(msg)``.  Returns per-worker outputs."""
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            fail("worker cluster hung")
+            return []
+        outs.append(out)
+    return outs

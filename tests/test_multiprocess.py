@@ -154,6 +154,44 @@ def test_health_checker_detects_dead_peer(tmp_path):
     assert procs[0].returncode == 0, out0[-4000:]
 
 
+TRAIN_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+result = run(TrainArgs(model="mnist", steps=6, batch_size=64, log_every=3))
+assert result["final_step"] == 6, result
+assert np.isfinite(result["loss"]), result
+print("TRAIN_OK", jax.process_index(), flush=True)
+os._exit(0)
+"""
+
+
+def test_two_process_train_lib_run(tmp_path):
+    """The FULL entrypoint (train_lib.run) on a real 2-worker cluster.
+
+    Regression test for two bugs only this path could expose: the
+    collective-mismatch fingerprint embedding per-process memory
+    addresses (guard tripped on identical programs), and HealthCheckHook
+    probing before the peer finished compiling (healthy run killed).
+    DTT_HEALTH_INTERVAL_S=2 makes probes actually fire during the run —
+    with 1-core serialized compiles the unarmed checker would trip within
+    ~4s while the peer is still compiling."""
+    from tests.helpers import join_workers, spawn_worker_cluster
+
+    procs = spawn_worker_cluster(
+        TRAIN_SCRIPT, 2, extra_env={"DTT_HEALTH_INTERVAL_S": "2"}
+    )
+    outs = join_workers(procs, timeout=420, fail=pytest.fail)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-4000:]}"
+        assert f"TRAIN_OK {i}" in out, out[-2000:]
+
+
 def test_two_process_localhost_cluster(tmp_path):
     import json
 

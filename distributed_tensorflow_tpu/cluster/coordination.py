@@ -75,7 +75,10 @@ def fingerprint(obj: Any) -> str:
     payload = json.dumps(
         [str(treedef)] + [repr(_canon(l)) for l in leaves], sort_keys=True
     )
-    payload = re.sub(r"0x[0-9a-fA-F]+", "0x", payload)
+    # Anchored to the object-repr form ("<function f at 0x7f..>") so real
+    # hex-valued data (e.g. an enum repr "flags=0x1f") still participates
+    # in the fingerprint instead of being masked.
+    payload = re.sub(r" at 0x[0-9a-fA-F]+", " at 0x", payload)
     return hashlib.sha256(payload.encode()).hexdigest()
 
 

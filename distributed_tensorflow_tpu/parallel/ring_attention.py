@@ -69,6 +69,41 @@ def _combine(acc, l_acc, m_acc, partial, l_new, m_new):
     return acc, l_next, m_next
 
 
+def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
+                          chunk):
+    """``_block_attend`` with the kv block processed in ``chunk``-sized
+    pieces under a scan: the (Tq, Tk) score tile never materializes —
+    only (Tq, chunk) — bounding per-ring-step memory for long per-shard
+    sequences.  Same un-normalized (acc, m, l) contract as
+    ``_block_attend`` (acc = sum of exp(s - m)·v rows), so the ring-level
+    combine is unchanged.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if Tk % chunk:
+        raise ValueError(f"kv block length {Tk} not divisible by "
+                         f"chunk_size {chunk}")
+
+    def body(carry, i):
+        acc, l_acc, m_acc = carry
+        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        partial, m_new, l_new = _block_attend(
+            q, k_c, v_c, q_offset=q_offset, k_offset=k_offset + i * chunk,
+            causal=causal, scale=scale,
+        )
+        acc, l_acc, m_acc = _combine(acc, l_acc, m_acc, partial, l_new, m_new)
+        return (acc, l_acc, m_acc), None
+
+    init = (
+        jnp.zeros((B, Tq, H, D), jnp.float32),
+        jnp.zeros((B, H, Tq), jnp.float32),
+        jnp.full((B, H, Tq), -1e30, jnp.float32),
+    )
+    (acc, l_acc, m_acc), _ = lax.scan(body, init, jnp.arange(Tk // chunk))
+    return acc, m_acc, l_acc
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -78,11 +113,18 @@ def ring_attention(
     axis: str = "context",
     causal: bool = True,
     batch_axes: tuple = ("data", "fsdp"),
+    chunk_size: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q, k, v: (B, T, H, D) global arrays, T sharded over ``axis``.
     Returns (B, T, H, D), sharded like q.
+
+    ``chunk_size`` bounds per-ring-step memory: each arriving kv block is
+    consumed in chunks of that many keys, so the biggest score tile is
+    (T/N, chunk_size) instead of (T/N, T/N) — at pod-scale sequence
+    lengths (e.g. 8k per shard) the difference between fitting in HBM and
+    not.  None processes whole blocks (fastest for short shards).
     """
     n = mesh.shape.get(axis, 1)
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -102,11 +144,18 @@ def ring_attention(
             # kv block currently held arrived from neighbor `my + i` (ring
             # shifts move blocks to lower indices each step).
             owner = (my + i) % n
-            partial, m_new, l_new = _block_attend(
-                q_blk, k_cur, v_cur,
-                q_offset=q_off, k_offset=owner * Tq,
-                causal=causal, scale=scale,
-            )
+            if chunk_size is not None and chunk_size < k_cur.shape[1]:
+                partial, m_new, l_new = _block_attend_chunked(
+                    q_blk, k_cur, v_cur,
+                    q_offset=q_off, k_offset=owner * Tq,
+                    causal=causal, scale=scale, chunk=chunk_size,
+                )
+            else:
+                partial, m_new, l_new = _block_attend(
+                    q_blk, k_cur, v_cur,
+                    q_offset=q_off, k_offset=owner * Tq,
+                    causal=causal, scale=scale,
+                )
             acc, l_acc, m_acc = _combine(acc, l_acc, m_acc,
                                          partial, l_new, m_new)
             # rotate kv around the ring (neighbor DMA on ICI)

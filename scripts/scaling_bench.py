@@ -26,6 +26,12 @@ def main():
     ap.add_argument("--per-chip-batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=15)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--input", choices=("cached", "loader"), default="cached",
+                    help="loader: feed every width through the real input "
+                         "path (staged records -> native loader -> device "
+                         "prefetch) instead of one cached batch")
+    ap.add_argument("--records", type=int, default=1024)
+    ap.add_argument("--data_dir", default="/tmp/dtt_bench_data")
     args = ap.parse_args()
 
     import jax
@@ -59,20 +65,50 @@ def main():
         state, _, step, bsh = build_state_and_step(
             wl, mesh, precision=BF16, total_steps=args.warmup + args.iters,
         )
-        it = make_global_batches(
-            wl.data_fn(per_host_batch_size(wl.batch_size)),
-            bsh[wl.example_key],
-        )
-        batch = next(it)
+        if args.input == "loader":
+            import os
+
+            from distributed_tensorflow_tpu.data.pipeline import (
+                DevicePrefetchIterator,
+            )
+            from distributed_tensorflow_tpu.data.records import (
+                record_data_fn,
+                record_path,
+                record_schema,
+                stage_synthetic_to_records,
+            )
+
+            path = record_path(args.data_dir, wl.name)
+            want = record_schema(wl).file_size(args.records)
+            if not (os.path.exists(path) and os.path.getsize(path) == want):
+                stage_synthetic_to_records(wl, path, args.records)
+            data_iter = iter(DevicePrefetchIterator(
+                record_data_fn(path, wl, num_threads=2, prefetch=4)(
+                    per_host_batch_size(wl.batch_size)),
+                bsh[wl.example_key], prefetch=2,
+            ))
+        else:
+            import itertools
+
+            it = make_global_batches(
+                wl.data_fn(per_host_batch_size(wl.batch_size)),
+                bsh[wl.example_key],
+            )
+            data_iter = itertools.repeat(next(it))
         rng = jax.random.key(0)
         for i in range(args.warmup):
-            state, _ = step(state, batch, jax.random.fold_in(rng, i))
+            state, _ = step(state, next(data_iter), jax.random.fold_in(rng, i))
         jax.block_until_ready(state.params)
         t0 = time.perf_counter()
         for i in range(args.iters):
-            state, _ = step(state, batch, jax.random.fold_in(rng, 99 + i))
+            state, _ = step(state, next(data_iter),
+                            jax.random.fold_in(rng, 99 + i))
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
+        close = getattr(data_iter, "close", None)
+        if callable(close):
+            close()  # stop the prefetch thread; free pinned device batches
+        del data_iter
         ips = wl.batch_size * args.iters / dt
         results[width] = ips / width
         print(json.dumps({
@@ -84,7 +120,8 @@ def main():
 
     base = results.get(1)
     summary = {
-        "metric": "resnet50_scaling_efficiency",
+        "metric": ("resnet50_scaling_efficiency" if args.input == "cached"
+                   else "resnet50_scaling_efficiency_loader_fed"),
         "platform": platform,
         "hardware_meaningful": bool(on_tpu and n_total > 1),
         "per_chip_batch": per_chip,

@@ -70,6 +70,41 @@ class TestRingAttention:
                 np.asarray(gr), np.asarray(gd), rtol=1e-4, atol=1e-4
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_chunked_blocks_match_dense(self, mesh_ctx, causal):
+        """chunk_size < per-shard block length: the kv block is consumed
+        in chunks under a scan (bounded score tile) — result unchanged."""
+        q, k, v = make_qkv(seed=11)
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        got = ring_attention(qs, ks, vs, mesh=mesh_ctx, causal=causal,
+                             chunk_size=2)  # per-shard block is 4
+        want = _dense_attention(q, k, v, causal=causal,
+                                scale=1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_chunked_gradients_match_dense(self, mesh_ctx):
+        q, k, v = make_qkv(T=16, seed=13)
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(
+                q, k, v, mesh=mesh_ctx, causal=True, chunk_size=1) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense_attention(
+                q, k, v, causal=True, scale=1.0 / np.sqrt(q.shape[-1])) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qs, ks, vs)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gd), rtol=1e-4, atol=1e-4
+            )
+
     def test_single_device_axis_falls_back(self, mesh_dp):
         # mesh without a context axis (size 1) → dense path
         q, k, v = make_qkv(T=8)

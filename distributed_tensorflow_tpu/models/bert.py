@@ -61,6 +61,8 @@ class BertConfig:
     remat: bool = True
     # Pallas fused attention (non-causal); drops attention-prob dropout
     use_flash_attention: bool = False
+    # Ring attention kv-chunk size (0 = whole blocks; see GPT2Config)
+    ring_chunk_size: int = 0
 
     @classmethod
     def base(cls, **kw):
@@ -96,7 +98,8 @@ class EncoderLayer(nn.Module):
             # Exact attention (online softmax); attention-prob dropout is
             # unavailable here, residual dropout remains.
             ctx = ring_attention(
-                q, k, v, mesh=self.mesh, causal=False
+                q, k, v, mesh=self.mesh, causal=False,
+                chunk_size=cfg.ring_chunk_size or None,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
             ctx = flash_attention(q, k, v, causal=False).reshape(B, T, d)
@@ -247,10 +250,13 @@ def make_workload(
     batch_size: int = 256,
     seq_len: int = 128,
     config: Optional[BertConfig] = None,
+    ring_chunk_size: Optional[int] = None,
     mesh: Optional[Mesh] = None,
     **_unused,
 ) -> Workload:
     cfg = config or BertConfig.base()
+    if ring_chunk_size is not None:
+        cfg = dataclasses.replace(cfg, ring_chunk_size=ring_chunk_size)
     seq = min(seq_len, cfg.max_positions)
     module = BertPretrain(cfg, mesh=mesh)
     # Init batch must divide over the batch-sharding axes when the mesh

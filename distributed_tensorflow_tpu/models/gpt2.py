@@ -65,6 +65,10 @@ class GPT2Config:
     # largest of {4S, 2S, S} dividing the batch).  Bubble fraction is
     # (S-1)/(M+S-1), so prefer M >= 4S.
     pipe_microbatches: int = 0
+    # Ring attention kv-chunk size (0 = whole per-shard blocks): bounds the
+    # per-ring-step score tile to (T/shards, ring_chunk_size) — set for
+    # pod-scale per-shard sequence lengths (see parallel.ring_attention).
+    ring_chunk_size: int = 0
 
     @classmethod
     def small(cls, **kw):
@@ -105,7 +109,8 @@ class Block(nn.Module):
             # attention; attention-prob dropout is unavailable here (the
             # full prob matrix never materializes), residual dropout remains.
             ctx = ring_attention(
-                q, k, v, mesh=self.mesh, causal=True
+                q, k, v, mesh=self.mesh, causal=True,
+                chunk_size=cfg.ring_chunk_size or None,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
             ctx = flash_attention(q, k, v, causal=True).reshape(B, T, d)
@@ -306,11 +311,14 @@ def make_workload(
     config: Optional[GPT2Config] = None,
     mesh: Optional[Mesh] = None,
     use_flash_attention: Optional[bool] = None,
+    ring_chunk_size: Optional[int] = None,
     **_unused,
 ) -> Workload:
     cfg = config or getattr(GPT2Config, preset)()
     if use_flash_attention is not None:
         cfg = dataclasses.replace(cfg, use_flash_attention=use_flash_attention)
+    if ring_chunk_size is not None:
+        cfg = dataclasses.replace(cfg, ring_chunk_size=ring_chunk_size)
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
         if not cfg.scan_layers:
             raise ValueError(

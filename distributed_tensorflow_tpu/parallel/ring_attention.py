@@ -100,7 +100,15 @@ def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
         jnp.zeros((B, H, Tq), jnp.float32),
         jnp.full((B, H, Tq), -1e30, jnp.float32),
     )
-    (acc, l_acc, m_acc), _ = lax.scan(body, init, jnp.arange(Tk // chunk))
+    # checkpoint the chunk body: without it, scan saves each chunk's
+    # (Tq, chunk) prob tile as a backward residual — stacking back up to
+    # the full (Tq, Tk) score tile this chunking exists to avoid.  With
+    # it, backward recomputes the chunk scores (flash-attention style) and
+    # only the per-step carries are stored.
+    (acc, l_acc, m_acc), _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), init,
+        jnp.arange(Tk // chunk),
+    )
     return acc, m_acc, l_acc
 
 

@@ -54,6 +54,8 @@ class TrainArgs:
     flash_attention: bool = False  # gpt2: Pallas fused attention, forward
     # and backward (~4.5x tokens/s on v5e; drops attention-prob dropout —
     # see GPT2Config)
+    ring_chunk_size: int = 0  # gpt2/bert with --context>1: kv-chunk size
+    # bounding per-ring-step attention memory (0 = whole blocks)
     steps: int = 200
     batch_size: Optional[int] = None  # global; default from workload
     grad_accum_steps: Optional[int] = None
@@ -93,6 +95,11 @@ def parse_args(argv=None) -> TrainArgs:
                         "(forward AND backward — no (T,T) score buffer in "
                         "either pass; ~4.5x tokens/s on v5e; drops "
                         "attention-prob dropout)")
+    p.add_argument("--ring_chunk_size", type=int, default=0,
+                   help="gpt2/bert with --context>1: consume ring-attention "
+                        "kv blocks in chunks of this many keys (bounds "
+                        "per-ring-step memory at long per-shard sequence "
+                        "lengths; 0 = whole blocks)")
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--grad_accum_steps", type=int, default=None)
@@ -181,6 +188,20 @@ def build_state_and_step(
     state_shardings = workload.rules.shardings_for(mesh, abstract_state)
     state = jax.jit(init_fn, out_shardings=state_shardings)()
 
+    # shard_map paths (ring attention over `context`, GPipe over `pipe`)
+    # need static per-shard shapes: every microbatch must divide the batch
+    # axes exactly.  Plain GSPMD paths tolerate uneven sharding, so only
+    # enforce where the cryptic shard_map divisibility error would hit.
+    if mesh.shape.get("context", 1) > 1 or mesh.shape.get("pipe", 1) > 1:
+        batch_par = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        micro = workload.batch_size // max(1, grad_accum_steps)
+        if micro % max(1, batch_par):
+            raise ValueError(
+                f"microbatch {micro} (= batch {workload.batch_size} / "
+                f"grad_accum {grad_accum_steps}) does not divide the batch "
+                f"axes data*fsdp={batch_par}; raise --batch_size or lower "
+                "--grad_accum_steps"
+            )
     raw_step = make_train_step(
         _wrap_from_record(workload, workload.loss_fn),
         grad_accum_steps=grad_accum_steps,
@@ -281,6 +302,14 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         if args.model != "gpt2":
             raise ValueError("--flash_attention currently applies to gpt2")
         overrides["use_flash_attention"] = True
+    if args.ring_chunk_size:
+        if args.model not in ("gpt2", "bert"):
+            raise ValueError("--ring_chunk_size applies to gpt2/bert "
+                             "(the ring-attention workloads)")
+        if args.context <= 1:
+            raise ValueError("--ring_chunk_size requires --context>1 "
+                             "(ring attention is the context-axis path)")
+        overrides["ring_chunk_size"] = args.ring_chunk_size
     workload = get_workload(args.model, **overrides)
     grad_accum = args.grad_accum_steps or workload.grad_accum_steps
     precision = BF16 if args.precision == "bf16" else FP32

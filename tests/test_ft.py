@@ -141,7 +141,7 @@ class Slow:
 
 print("PSM_TRAIN_READY", flush=True)
 loop = TrainLoop(step, state, Slow(), hooks=[hook], metrics_every=1)
-final = loop.run(4000)
+final = loop.run(2000)
 stopped = int(jax.device_get(final.step))
 assert hook.handled, "hook never saw the platform preemption notice"
 assert mgr.saved and mgr.saved[-1] == stopped
@@ -189,11 +189,11 @@ def test_platform_preemption_notice_stops_both_workers(tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail("workers never reached training")
-        time.sleep(8.0)
+        time.sleep(10.0)
         procs[1].send_signal(signal.SIGTERM)  # scheduler preempts worker 1
         outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=300)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for q in procs:

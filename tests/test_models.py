@@ -177,6 +177,36 @@ class TestGPT2:
         state, hist = run_steps(wl, mesh_dp, 3, grad_accum=2)
         assert np.isfinite([m["loss"] for m in hist]).all()
 
+    def test_context_parallel_chunked_ring_matches_dp(self, mesh_dp, mesh_4d):
+        # ring_chunk_size < per-shard block: the chunked (bounded-memory)
+        # ring path through the workload override must match DP loss.
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        def make(mesh, **kw):
+            return get_workload(
+                "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+                grad_accum_steps=1, mesh=mesh, **kw,
+            )
+
+        l_dp = [m["loss"] for m in run_steps(make(None), mesh_dp, 3)[1]]
+        l_cp = [m["loss"] for m in run_steps(
+            make(mesh_4d, ring_chunk_size=8), mesh_4d, 3)[1]]
+        np.testing.assert_allclose(l_dp, l_cp, rtol=2e-2)
+
+    def test_microbatch_must_divide_batch_axes_on_ring_mesh(self, mesh_4d):
+        # On a context>1 mesh (the shard_map ring path), batch 8 /
+        # accum 8 = microbatch 1 cannot divide data*fsdp=2: a clear error
+        # instead of a cryptic shard_map divisibility failure.
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        wl = get_workload(
+            "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+            grad_accum_steps=8, mesh=mesh_4d,
+        )
+        with pytest.raises(ValueError, match="microbatch"):
+            build_state_and_step(wl, mesh_4d, grad_accum_steps=8,
+                                 total_steps=2)
+
     def test_pipeline_parallel_matches_dp_loss(self, mesh_dp):
         # data=2 x tensor=2 x pipe=2: the GPipe schedule + TP inside stages
         # must reproduce the pure-DP loss trajectory (same math, reordered).

@@ -178,13 +178,14 @@ def test_two_process_train_lib_run(tmp_path):
     collective-mismatch fingerprint embedding per-process memory
     addresses (guard tripped on identical programs), and HealthCheckHook
     probing before the peer finished compiling (healthy run killed).
-    DTT_HEALTH_INTERVAL_S=2 makes probes actually fire during the run —
-    with 1-core serialized compiles the unarmed checker would trip within
-    ~4s while the peer is still compiling."""
+    DTT_HEALTH_INTERVAL_S=5 makes probes actually fire during the run —
+    with 1-core serialized 30-60s compiles the unarmed checker would trip
+    within ~10s while the peer is still compiling, while the armed one
+    keeps a 3.75s barrier timeout that tolerates test-host load."""
     from tests.helpers import join_workers, spawn_worker_cluster
 
     procs = spawn_worker_cluster(
-        TRAIN_SCRIPT, 2, extra_env={"DTT_HEALTH_INTERVAL_S": "2"}
+        TRAIN_SCRIPT, 2, extra_env={"DTT_HEALTH_INTERVAL_S": "5"}
     )
     outs = join_workers(procs, timeout=420, fail=pytest.fail)
     for i, (p, out) in enumerate(zip(procs, outs)):

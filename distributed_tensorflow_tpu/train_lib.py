@@ -52,8 +52,8 @@ class TrainArgs:
     model: str = "mnist"
     arch: Optional[str] = None  # sub-architecture (wide_deep | dlrm)
     flash_attention: bool = False  # gpt2: Pallas fused attention, forward
-    # and backward (~4.5x tokens/s on v5e; drops attention-prob dropout —
-    # see GPT2Config)
+    # and backward (~6.6x tokens/s vs dense+accum on v5e; drops
+    # attention-prob dropout — see GPT2Config)
     ring_chunk_size: int = 0  # gpt2/bert with --context>1: kv-chunk size
     # bounding per-ring-step attention memory (0 = whole blocks)
     steps: int = 200
@@ -93,8 +93,8 @@ def parse_args(argv=None) -> TrainArgs:
     p.add_argument("--flash_attention", action="store_true",
                    help="gpt2: use the Pallas fused-attention kernels "
                         "(forward AND backward — no (T,T) score buffer in "
-                        "either pass; ~4.5x tokens/s on v5e; drops "
-                        "attention-prob dropout)")
+                        "either pass; ~6.6x tokens/s vs dense+accum on "
+                        "v5e; drops attention-prob dropout)")
     p.add_argument("--ring_chunk_size", type=int, default=0,
                    help="gpt2/bert with --context>1: consume ring-attention "
                         "kv blocks in chunks of this many keys (bounds "

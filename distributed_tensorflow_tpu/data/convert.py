@@ -18,8 +18,10 @@ Pieces:
 
 - ``iter_tfrecord(path)``: pure-python reader of the TFRecord wire format
   (u64 length + masked crc32c + payload + crc — the framing written by
-  TFRecordWriter).  CRCs are not verified (we are converting, not serving;
-  a corrupt length still fails fast on framing).
+  TFRecordWriter).  Framing truncation (header, payload, OR trailing CRC)
+  always raises; content CRCs are verified with ``verify=True``
+  (masked crc32c, the TFRecordReader check) — off by default since the
+  common corruption mode, truncation, is caught by framing alone.
 - ``parse_example(buf)``: tf.train.Example protobuf -> {name: np.ndarray}
   (bytes features stay ``object`` arrays — decode them in ``transform``).
 - ``convert_tfrecords(...)``: streams examples through ``transform`` and
@@ -41,9 +43,54 @@ logger = logging.getLogger(__name__)
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
 
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table = None
 
-def iter_tfrecord(path: str) -> Iterator[bytes]:
-    """Yield raw record payloads from one TFRecord file."""
+
+try:  # C extension when available: verify=True at native speed
+    from google_crc32c import value as _crc32c_fast
+except ImportError:
+    try:
+        from crc32c import crc32c as _crc32c_fast
+    except ImportError:
+        _crc32c_fast = None
+
+
+def _crc32c(data: bytes) -> int:
+    """crc32c (Castagnoli) — the checksum TFRecord frames use.  C extension
+    when installed; pure-python table fallback otherwise (slow — fine for
+    spot checks, not multi-GB verified conversions)."""
+    if _crc32c_fast is not None:
+        return _crc32c_fast(data)
+    global _crc32c_table
+    if _crc32c_table is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+            tbl.append(c)
+        _crc32c_table = tbl
+    crc = 0xFFFFFFFF
+    tbl = _crc32c_table
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def iter_tfrecord(path: str, *, verify: bool = False) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file.
+
+    Truncation anywhere in the frame (header, payload, or trailing CRC)
+    raises.  ``verify=True`` additionally checks both masked crc32c values,
+    so a corrupt-but-well-framed shard fails instead of converting garbage
+    into training data.
+    """
     with open(path, "rb") as f:
         while True:
             hdr = f.read(12)  # u64 length + u32 masked-crc(length)
@@ -52,10 +99,16 @@ def iter_tfrecord(path: str) -> Iterator[bytes]:
             if len(hdr) < 12:
                 raise ValueError(f"{path}: truncated TFRecord header")
             (length,) = _U64.unpack(hdr[:8])
+            if verify and _U32.unpack(hdr[8:])[0] != _masked_crc(hdr[:8]):
+                raise ValueError(f"{path}: TFRecord length CRC mismatch")
             payload = f.read(length)
             if len(payload) < length:
                 raise ValueError(f"{path}: truncated TFRecord payload")
-            f.read(4)  # masked-crc(payload); not verified
+            crc_buf = f.read(4)  # masked-crc(payload)
+            if len(crc_buf) < 4:
+                raise ValueError(f"{path}: truncated TFRecord payload CRC")
+            if verify and _U32.unpack(crc_buf)[0] != _masked_crc(payload):
+                raise ValueError(f"{path}: TFRecord payload CRC mismatch")
             yield payload
 
 
@@ -93,6 +146,7 @@ def convert_tfrecords(
     parse_fn: Optional[Callable[[bytes], Dict[str, np.ndarray]]] = None,
     limit: Optional[int] = None,
     chunk: int = 512,
+    verify: bool = False,
 ) -> int:
     """Convert TFRecord shards into the workload's RecordFile at out_path.
 
@@ -115,7 +169,7 @@ def convert_tfrecords(
 
     def example_stream() -> Iterator[Dict[str, np.ndarray]]:
         for path in tfrecord_paths:
-            for payload in iter_tfrecord(path):
+            for payload in iter_tfrecord(path, verify=verify):
                 ex = parse(payload)
                 yield transform(ex) if transform is not None else ex
 

@@ -21,6 +21,14 @@ the workload via ``records.record_schema``):
 The payload is exactly the loader's batch buffer — no pickling, no
 serialization layer; the client unpacks with ``RecordFile.unpack`` just as
 the in-process path does.
+
+Limitations (deliberate, documented): ONE server per record file — there is
+no dispatcher/replica tier (tf.data service's dispatcher + N workers), so
+the service is a single point of failure for input.  A server death
+mid-stream surfaces in every consumer as ``DataServiceError`` naming the
+service address (not a silent clean end-of-data — the trainer must not
+mistake an input outage for epoch end), and the trainer exits with that
+error; restart-and-resume goes through the normal checkpoint path.
 """
 
 from __future__ import annotations
@@ -39,6 +47,12 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
 _HDR = struct.Struct("<QQ")
+
+
+class DataServiceError(ConnectionError):
+    """The data service became unreachable mid-stream (server died or the
+    connection dropped).  Distinct from clean end-of-data (StopIteration):
+    the trainer should fail with this error, not treat it as epoch end."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -206,6 +220,7 @@ class DataServiceIterator:
 
     def __init__(self, address: str, record: RecordFile, batch_size: int):
         host, port = address.rsplit(":", 1)
+        self.address = address
         self.record = record
         self.batch_size = batch_size
         self._sock = socket.create_connection((host, int(port)), timeout=60)
@@ -231,11 +246,21 @@ class DataServiceIterator:
         return self
 
     def __next__(self) -> dict:
-        self._sock.sendall(b"N")
-        (length,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
-        if length == 0:
-            raise StopIteration
-        raw = _recv_exact(self._sock, length)
+        try:
+            self._sock.sendall(b"N")
+            (length,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+            if length == 0:
+                raise StopIteration
+            raw = _recv_exact(self._sock, length)
+        except (ConnectionError, BrokenPipeError, OSError) as e:
+            if isinstance(e, DataServiceError):
+                raise
+            raise DataServiceError(
+                f"data service at {self.address} disconnected mid-stream "
+                f"({e}); the input server died or the network dropped — "
+                "restart the service and resume the trainer from its "
+                "checkpoint"
+            ) from e
         flat = np.frombuffer(raw, dtype=np.uint8).reshape(
             self.batch_size, self.record.record_bytes
         )

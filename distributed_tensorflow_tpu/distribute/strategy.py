@@ -109,12 +109,16 @@ class Strategy:
 
         if args:
             args = (jax.tree.map(_place_batch, args[0]),) + tuple(args[1:])
-        jitted = self._jitted.get(fn)
+        jitted = self._jitted.pop(fn, None)
         if jitted is None:
             if len(self._jitted) >= self._jitted_max:
-                self._jitted.clear()  # per-call-lambda misuse: cap, retrace
+                # LRU-evict one entry (dict preserves insertion order and a
+                # hit re-inserts at the back): a per-call-lambda misuser
+                # churns their own slots while stable hot functions stay
+                # recent and keep their traces.
+                self._jitted.pop(next(iter(self._jitted)))
             jitted = jax.jit(fn)
-            self._jitted[fn] = jitted
+        self._jitted[fn] = jitted  # (re-)insert at the back = most recent
         return jitted(*args, **kwargs)
 
     def reduce(self, reduce_op: str, value: PyTree, axis: Optional[int] = 0):

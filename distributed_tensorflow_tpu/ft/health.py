@@ -88,27 +88,41 @@ class HealthChecker:
         interval_s: float = 30.0,
         timeout_s: float = 20.0,
         failures_before_action: int = 2,
+        startup_grace_s: float = 600.0,
         probe: Optional[Callable[[float], bool]] = None,
         on_failure: Optional[Callable[[], None]] = None,
     ):
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.failures_before_action = failures_before_action
+        self.startup_grace_s = startup_grace_s
         self._probe = probe or make_default_probe(interval_s)
         self._on_failure = on_failure
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._consecutive_failures = 0
+        self._ready = False
+        self._started_at: Optional[float] = None
         self.error: Optional[Exception] = None
 
     def start(self) -> "HealthChecker":
         if self._thread is not None:
             return self
+        self._started_at = time.time()
         self._thread = threading.Thread(
             target=self._run, name="dtt-health-check", daemon=True
         )
         self._thread.start()
         return self
+
+    def mark_ready(self) -> None:
+        """Startup is over (first cluster-wide step completed): failed
+        probes now count against ``failures_before_action`` directly
+        instead of the startup grace window.  Failures accumulated while
+        the grace tolerated them don't carry over."""
+        if not self._ready:
+            self._consecutive_failures = 0
+        self._ready = True
 
     def stop(self) -> None:
         self._stop.set()
@@ -132,8 +146,25 @@ class HealthChecker:
                 logger.error("health probe raised: %s", e)
             if healthy:
                 self._consecutive_failures = 0
+                self._ready = True  # one full barrier proves every peer is up
                 continue
             self._consecutive_failures += 1
+            if not self._ready:
+                # Startup: peers may legitimately miss probe barriers while
+                # they compile (skewed startup), so failures are fatal only
+                # once the grace window is exhausted — a peer that NEVER
+                # comes up still surfaces instead of hanging this worker in
+                # the first collective forever.  Tolerated failures reset
+                # the counter so they never carry past the grace window.
+                elapsed = time.time() - (self._started_at or 0.0)
+                if elapsed < self.startup_grace_s:
+                    self._consecutive_failures = 0
+                    logger.warning(
+                        "health probe failed during startup grace "
+                        "(%.0fs/%.0fs elapsed); tolerating",
+                        elapsed, self.startup_grace_s,
+                    )
+                    continue
             if self._consecutive_failures >= self.failures_before_action:
                 self.error = RuntimeError(
                     f"cluster unhealthy: {self._consecutive_failures} "
@@ -150,32 +181,31 @@ class HealthChecker:
 
 
 class HealthCheckHook:
-    """Training-loop hook running a ``HealthChecker``: armed after the FIRST
-    step completes, consulted at every later step boundary (the worker
-    raises instead of hanging in a collective whose peer died — MWMS's
-    check-health thread behavior, $TF collective_all_reduce_strategy.py:340),
-    stopped at ``end``.
+    """Training-loop hook running a ``HealthChecker``: probes start at loop
+    ``begin`` under a startup grace window, tighten to
+    ``failures_before_action`` once the first step completes, and are
+    consulted at every step boundary (the worker raises instead of hanging
+    in a collective whose peer died — MWMS's check-health thread behavior,
+    $TF collective_all_reduce_strategy.py:340).  Stopped at ``end``.
 
-    Arming at step 1 — not at loop begin — matters: the first step is
-    itself a cluster-wide collective, so its completion proves every peer
-    is up and compiled.  Starting probes at loop begin false-positives on
-    skewed startup (a peer still compiling misses ``failures_before_action``
-    probe barriers and a HEALTHY run gets killed — observed with two
-    workers sharing one host core, where compiles serialize).
+    Two regimes, because both failure modes are real: a peer still
+    compiling misses probe barriers during skewed startup (observed with
+    two workers sharing one host core, where compiles serialize) — so
+    pre-first-step failures are tolerated for ``startup_grace_s``; but a
+    peer that NEVER comes up must still surface as an error rather than
+    leaving survivors in the first collective forever — so the grace is a
+    window, not an off switch.  The first completed step (or first
+    successful probe barrier) proves every peer is up and ends the grace.
     """
 
     def __init__(self, checker: Optional[HealthChecker] = None, **kw):
         self.checker = checker or HealthChecker(**kw)
-        self._armed = False
 
-    def begin(self, loop) -> None:  # arming happens at the first step
-        pass
+    def begin(self, loop) -> None:
+        self.checker.start()
 
     def after_step(self, loop, step, metrics) -> None:
-        if not self._armed:
-            self._armed = True
-            self.checker.start()
-            return
+        self.checker.mark_ready()
         self.checker.raise_if_unhealthy()
 
     def end(self, loop, step) -> None:

@@ -65,6 +65,11 @@ class WideDeep(nn.Module):
     mesh: Optional[Mesh] = None
     shard_axis: str = "data"
     dtype: Any = jnp.bfloat16
+    # Replicate the wide tower's (V, 1) scalar table instead of row-sharding
+    # it: lookups go fully local and backward syncs sparse grads with
+    # psum_sparse (all_reduce_indexed_slices role) — the right trade for a
+    # table whose dense gradient is a single scalar column.
+    replicate_wide: bool = False
 
     @nn.compact
     def __call__(self, batch: Dict[str, jax.Array]):
@@ -80,7 +85,8 @@ class WideDeep(nn.Module):
         deep_logit = MLP(self.deep_layers, self.dtype, name="deep")(deep_in)
         # Wide tower: linear over sparse (scalar table) + dense linear
         wide_emb = ShardedEmbed(self.vocab_size, 1, mesh=self.mesh,
-                                axis=self.shard_axis, name="wide_embed")(sparse)
+                                axis=self.shard_axis, name="wide_embed",
+                                replicated=self.replicate_wide)(sparse)
         wide_logit = (
             wide_emb.sum(axis=(1, 2), dtype=jnp.float32)[:, None]
             + nn.Dense(1, dtype=jnp.float32, name="wide_dense")(dense)
@@ -182,13 +188,15 @@ def _loss_fn(module: nn.Module, params, batch: Dict[str, jax.Array], rng):
     return loss, {"accuracy": acc}
 
 
-def recsys_rules(shard_axis: str = "data") -> ShardingRules:
-    """Tables row-sharded (PS-replacement); MLPs replicated (they're small)."""
-    return ShardingRules(
-        [
-            (r"(deep_embed|wide_embed)/embedding", P(shard_axis)),
-        ]
-    )
+def recsys_rules(shard_axis: str = "data", *,
+                 wide_replicated: bool = False) -> ShardingRules:
+    """Tables row-sharded (PS-replacement); MLPs replicated (they're small).
+    ``wide_replicated`` keeps the wide tower's scalar table replicated to
+    match ``WideDeep(replicate_wide=True)``'s psum_sparse gradient path."""
+    rules = [(r"deep_embed/embedding", P(shard_axis))]
+    rules.append((r"wide_embed/embedding",
+                  P() if wide_replicated else P(shard_axis)))
+    return ShardingRules(rules)
 
 
 def make_workload(
@@ -202,6 +210,7 @@ def make_workload(
     mesh: Optional[Mesh] = None,
     shard_axis: str = "data",
     feature_configs: Optional[Sequence[FeatureConfig]] = None,
+    replicate_wide_table: bool = False,
     **_unused,
 ) -> Workload:
     # Multi-table path: explicit config, or automatically when the mesh has
@@ -232,7 +241,8 @@ def make_workload(
             )
     elif arch == "wide_deep":
         module = WideDeep(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
-                          shard_axis=shard_axis)
+                          shard_axis=shard_axis,
+                          replicate_wide=replicate_wide_table)
     elif arch == "dlrm":
         module = DLRM(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
                       shard_axis=shard_axis,
@@ -267,7 +277,8 @@ def make_workload(
             batch_size=per_host_bs, num_dense=num_dense,
             num_sparse=num_sparse, vocab_size=vocab_size, holdout=True,
         ),
-        rules=rules if multi_table else recsys_rules(shard_axis),
+        rules=rules if multi_table else recsys_rules(
+            shard_axis, wide_replicated=replicate_wide_table),
         batch_size=batch_size,
         learning_rate=1e-3,
         warmup_steps=100,

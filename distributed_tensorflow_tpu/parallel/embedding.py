@@ -102,6 +102,68 @@ def sharded_lookup(
     )(table, ids)
 
 
+def replicated_lookup(
+    table: jax.Array,
+    ids: jax.Array,
+    *,
+    mesh: Mesh,
+    batch_axes: Sequence[str] = ("data",),
+) -> jax.Array:
+    """Gather ``table[ids]`` with the table REPLICATED over the mesh.
+
+    The forward is a purely local gather per batch shard (no collective at
+    all); the backward all-reduces the per-shard sparse (ids, values)
+    gradients into the dense replicated-table gradient with ``psum_sparse``
+    — TF's ``all_reduce_indexed_slices`` role ($TF/python/distribute/
+    cross_device_utils.py:516) for replicated small tables.  Use when the
+    table is small enough that a dense (V, D) gradient per chip is cheaper
+    than ``sharded_lookup``'s all_gather + psum_scatter exchange (e.g. the
+    Wide tower's (V, 1) scalar table); huge tables stay on
+    ``sharded_lookup``, which never materializes a dense gradient.
+    """
+    from distributed_tensorflow_tpu.parallel.collectives import psum_sparse
+
+    axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    if not axes:
+        return jnp.take(table, ids, axis=0)
+    vocab = table.shape[0]
+
+    # custom_vjp sits OUTSIDE the shard_maps: shard_map's own transpose of a
+    # P() input psums the per-shard cotangents, which would double-count the
+    # explicit psum_sparse below.
+    take_local = jax.shard_map(
+        lambda t, i: jnp.take(t, i, axis=0),
+        mesh=mesh, in_specs=(P(), P(axes)), out_specs=P(axes),
+        check_vma=False,
+    )
+
+    def scatter_psum(i, g):
+        def _local(i_s, g_s):
+            flat_i = i_s.reshape(-1)
+            flat_g = g_s.reshape((-1,) + g_s.shape[i_s.ndim:])
+            return psum_sparse(flat_g, flat_i, axes, dense_size=vocab)
+
+        # out_specs P(): every shard holds the identical post-psum dense
+        # gradient — the replicated table's cotangent.
+        return jax.shard_map(
+            _local, mesh=mesh, in_specs=(P(axes), P(axes)), out_specs=P(),
+            check_vma=False,
+        )(i, g)
+
+    @jax.custom_vjp
+    def _lookup(t, i):
+        return take_local(t, i)
+
+    def _fwd(t, i):
+        return take_local(t, i), i
+
+    def _bwd(i, g):
+        return scatter_psum(i, g).astype(table.dtype), None
+
+    _lookup.defvjp(_fwd, _bwd)
+    return _lookup(table, ids)
+
+
 class ShardedEmbed(nn.Module):
     """Row-sharded embedding layer (drop-in for ``nn.Embed`` at scale).
 
@@ -121,9 +183,16 @@ class ShardedEmbed(nn.Module):
     # data axes here: the exchange then delivers every batch shard its rows
     # replicated over the table axis (see sharded_lookup).
     batch_axes: Optional[Sequence[str]] = None
+    # Replicated mode: the table lives in full on every chip, lookups are
+    # local, and backward syncs sparse grads via psum_sparse (TF's
+    # all_reduce_indexed_slices path) — for small tables only.  The matching
+    # sharding rule (make_rule) becomes P().
+    replicated: bool = False
 
     def setup(self):
         n = self.mesh.shape.get(self.axis, 1) if self.mesh is not None else 1
+        if self.replicated:
+            n = 1  # no shard-divisibility padding needed
         self.padded_vocab = pad_vocab(self.num_embeddings, n)
         self.embedding = self.param(
             "embedding",
@@ -133,15 +202,22 @@ class ShardedEmbed(nn.Module):
         )
 
     def __call__(self, ids: jax.Array) -> jax.Array:
-        if self.mesh is None or self.mesh.shape.get(self.axis, 1) == 1:
+        if self.mesh is None or (
+            not self.replicated and self.mesh.shape.get(self.axis, 1) == 1
+        ):
             return jnp.take(self.embedding, ids, axis=0)
+        if self.replicated:
+            return replicated_lookup(
+                self.embedding, ids, mesh=self.mesh,
+                batch_axes=self.batch_axes or (self.axis,),
+            )
         return sharded_lookup(
             self.embedding, ids, mesh=self.mesh, axis=self.axis,
             batch_axes=self.batch_axes,
         )
 
     def make_rule(self) -> tuple:
-        return (r"embedding$", P(self.axis))
+        return (r"embedding$", P() if self.replicated else P(self.axis))
 
 
 def partitioned_shape(

@@ -48,7 +48,10 @@ class TableConfig:
     vocabulary_size: int
     dim: int
     name: str
-    combiner: str = "sum"  # sum | mean, for multi-valent features
+    # sum | mean, for multi-valent features.  Default "mean" matches the
+    # modeled TPUEmbedding TableConfig default (tpu_embedding_v2_utils.py:
+    # 1319), so mechanically-ported configs keep their pooling semantics.
+    combiner: str = "mean"
     optimizer: Optional[optax.GradientTransformation] = None
 
     def __post_init__(self):

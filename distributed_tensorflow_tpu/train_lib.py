@@ -365,6 +365,10 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         hooks.append(HealthCheckHook(
             interval_s=interval,
             timeout_s=min(20.0, max(1.0, interval * 0.75)),
+            # Skewed startup/compile beyond 10 min is legitimate for big
+            # models — the grace must be raisable without a code change.
+            startup_grace_s=float(
+                os.environ.get("DTT_HEALTH_STARTUP_GRACE_S", "600")),
         ))
     manager = None
     if args.checkpoint_dir:
@@ -414,12 +418,20 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     )
     start_step = int(jax.device_get(state.step))
     remaining = max(0, args.steps - start_step)
-    final_state = loop.run(remaining)
-
-    data_iter.close()
-    if manager is not None:
-        manager.close()
-    server.shutdown()
+    try:
+        final_state = loop.run(remaining)
+    finally:
+        # Teardown runs on errors too: the data-service client must send
+        # its quit opcode (else the trainer socket and the server's
+        # per-connection serve thread persist until process exit), and the
+        # prefetch thread / checkpoint manager / server must not leak
+        # across repeated in-process runs (as in tests).
+        data_iter.close()
+        if callable(getattr(host_iter, "close", None)):
+            host_iter.close()
+        if manager is not None:
+            manager.close()
+        server.shutdown()
 
     result = {
         "final_step": int(jax.device_get(final_state.step)),

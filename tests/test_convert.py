@@ -51,6 +51,35 @@ def test_iter_and_parse_roundtrip(tmp_path):
         assert g["y"][0] == ex["y"]
 
 
+def test_truncated_trailing_crc_raises(tmp_path):
+    """A file cut inside the final 4-byte payload CRC must raise, not be
+    accepted silently (ADVICE r2)."""
+    p = tmp_path / "t.tfrecord"
+    _write_tfrecord(p, [{"x": np.float32([1, 2]), "y": np.int64(0)}])
+    raw = p.read_bytes()
+    (tmp_path / "cut.tfrecord").write_bytes(raw[:-2])  # inside the CRC
+    with pytest.raises(ValueError, match="truncated TFRecord payload CRC"):
+        list(iter_tfrecord(str(tmp_path / "cut.tfrecord")))
+
+
+def test_verify_catches_corruption_and_passes_clean(tmp_path):
+    p = tmp_path / "v.tfrecord"
+    examples = [{"x": np.float32([i, i + 1]), "y": np.int64(i)}
+                for i in range(3)]
+    _write_tfrecord(p, examples)
+    # clean file verifies
+    assert len(list(iter_tfrecord(str(p), verify=True))) == 3
+    # flip one payload byte: well-framed but corrupt -> verify raises,
+    # non-verify (framing-only) still yields all records
+    raw = bytearray(p.read_bytes())
+    raw[15] ^= 0xFF  # first payload byte (after 12-byte header)
+    bad = tmp_path / "bad.tfrecord"
+    bad.write_bytes(bytes(raw))
+    assert len(list(iter_tfrecord(str(bad)))) == 3
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        list(iter_tfrecord(str(bad), verify=True))
+
+
 def test_convert_then_train_mnist(tmp_path):
     """Full ingestion path: TFRecord shards -> RecordFile -> native loader
     -> training (loss finite)."""

@@ -111,6 +111,39 @@ class TestDataService:
         finally:
             server.stop()
 
+    def test_mid_stream_death_raises_clear_error(self, tmp_path):
+        """VERDICT weak #5: a server that DIES mid-stream (no clean
+        end-of-stream frame) must surface as DataServiceError naming the
+        service address — not a bare ConnectionError, and NOT a silent
+        StopIteration the trainer would mistake for epoch end."""
+        from distributed_tensorflow_tpu.data.service import DataServiceError
+
+        wl = get_workload("mnist", batch_size=32)
+        path = record_path(str(tmp_path), "mnist")
+        stage_synthetic_to_records(wl, path, 64)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tensorflow_tpu.data.service",
+             "--model=mnist", f"--data_dir={tmp_path}", "--batch_size=32"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("DATA_SERVICE_READY"), line
+            target = line.split()[1]
+            it = DataServiceIterator(target, record_schema(wl), 32)
+            next(it)  # stream is live
+            proc.kill()  # hard death: no clean 0-length frame
+            proc.wait(timeout=30)
+            with pytest.raises(DataServiceError, match=target.split(":")[0]):
+                for _ in range(10_000):  # buffered batches may drain first
+                    next(it)
+            it.close()  # close after death must not raise
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
     def test_out_of_process_server(self, tmp_path):
         """VERDICT #7 done-criterion: a REAL separate server process (the
         CLI) feeds a training run in this process."""

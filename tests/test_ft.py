@@ -214,6 +214,7 @@ class TestHealthChecker:
             interval_s=0.01, failures_before_action=2,
             probe=lambda t: False, on_failure=lambda: calls.append(1),
         )
+        hc.mark_ready()  # post-startup regime: failures count directly
         hc.start()
         deadline = time.time() + 5
         while hc.error is None and time.time() < deadline:
@@ -223,6 +224,36 @@ class TestHealthChecker:
         assert calls == [1]
         with pytest.raises(RuntimeError):
             hc.raise_if_unhealthy()
+
+    def test_startup_grace_tolerates_then_raises(self):
+        """ADVICE r2: probes armed from loop begin must tolerate failed
+        probes during startup (peer still compiling) but still surface a
+        peer that NEVER comes up once the grace window is exhausted."""
+        hc = HealthChecker(
+            interval_s=0.01, failures_before_action=1,
+            startup_grace_s=0.3, probe=lambda t: False,
+        )
+        hc.start()
+        time.sleep(0.1)
+        assert hc.error is None  # inside the grace window
+        deadline = time.time() + 5
+        while hc.error is None and time.time() < deadline:
+            time.sleep(0.01)
+        hc.stop()
+        assert hc.error is not None  # grace exhausted -> raise
+
+    def test_mark_ready_ends_grace_immediately(self):
+        hc = HealthChecker(
+            interval_s=0.01, failures_before_action=2,
+            startup_grace_s=3600.0, probe=lambda t: False,
+        )
+        hc.mark_ready()  # first step completed: normal thresholds apply
+        hc.start()
+        deadline = time.time() + 5
+        while hc.error is None and time.time() < deadline:
+            time.sleep(0.01)
+        hc.stop()
+        assert hc.error is not None
 
     def test_recovery_resets_counter(self):
         results = iter([False, True, False, True, True])

@@ -197,6 +197,7 @@ def synthetic_mlm(
     rng = np.random.RandomState(seed * 3001 + index + (500_009 if holdout else 0))
     half = seq_len // 2
     K = mlm_max_predictions(seq_len, mask_rate)
+    positions_idx = np.arange(seq_len)[None, :]
     while True:
         start = rng.randint(2, vocab_size, size=(batch_size, 1))
         steps = rng.randint(1, 7, size=(batch_size, seq_len))
@@ -208,19 +209,24 @@ def synthetic_mlm(
         rand_seg = rng.randint(2, vocab_size, size=(batch_size, seq_len - half))
         second = np.where(nsp[:, None] == 1, tokens[:, half:], rand_seg)
         tokens = np.concatenate([tokens[:, :half], second], axis=1)
-        segment_ids = np.concatenate(
-            [np.zeros((batch_size, half)), np.ones((batch_size, seq_len - half))],
-            axis=1,
-        )
-        # K distinct masked positions per example (first K of a permutation)
-        positions = np.argsort(
-            rng.rand(batch_size, seq_len), axis=1
-        )[:, :K].astype(np.int32)
+        # Variable lengths (the reference's real wiki batches are padded):
+        # length in [half, seq_len]; tokens past it are 0-padding and the
+        # input_mask marks validity — attention must not read them.
+        lengths = rng.randint(half, seq_len + 1, size=(batch_size, 1))
+        input_mask = (positions_idx < lengths).astype(np.int32)
+        tokens = np.where(input_mask > 0, tokens, 0)
+        segment_ids = ((positions_idx >= half) & (positions_idx < lengths))
+        # K distinct masked positions per example, all within the valid
+        # length (half >= K guarantees enough candidates): padded slots'
+        # sort keys are pushed past every valid slot's.
+        sort_keys = rng.rand(batch_size, seq_len) + (input_mask == 0) * 2.0
+        positions = np.argsort(sort_keys, axis=1)[:, :K].astype(np.int32)
         targets = np.take_along_axis(tokens, positions, axis=1)
         masked = tokens.copy()
         np.put_along_axis(masked, positions, mask_token, axis=1)
         yield {
             "tokens": masked.astype(np.int32),
+            "input_mask": input_mask,
             "mlm_positions": positions,
             "mlm_targets": targets.astype(np.int32),
             "mlm_weights": np.ones((batch_size, K), np.float32),

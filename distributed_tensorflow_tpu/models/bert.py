@@ -59,7 +59,14 @@ class BertConfig:
     # scan-over-layers + per-layer remat (see GPT2Config for rationale)
     scan_layers: bool = True
     remat: bool = True
-    # Pallas fused attention (non-causal); drops attention-prob dropout
+    # Pallas fused attention (non-causal); drops attention-prob dropout.
+    # Default is per-phase, set by make_workload from measurement (v5e,
+    # 2026-07-30, masked batches): dense wins at seq 128 (867 vs 781
+    # seq/s/chip — the (T,T) tile is small enough that XLA's fused dense
+    # path beats the kernel's fixed overheads), flash wins at seq 512
+    # (219 vs 128 seq/s/chip, +71% — phase 2, where the score tile starts
+    # to dominate HBM traffic).  Crossover is between those; make_workload
+    # enables flash at seq >= 256.
     use_flash_attention: bool = False
     # Ring attention kv-chunk size (0 = whole blocks; see GPT2Config)
     ring_chunk_size: int = 0
@@ -80,7 +87,7 @@ class EncoderLayer(nn.Module):
     deterministic: bool = True  # attribute (not call arg) so nn.scan can map
 
     @nn.compact
-    def __call__(self, x, _=None):
+    def __call__(self, x, input_mask=None):
         cfg = self.cfg
         deterministic = self.deterministic
         d, h = cfg.d_model, cfg.n_head
@@ -94,17 +101,29 @@ class EncoderLayer(nn.Module):
         v = v.reshape(B, T, h, head_dim)
         if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
             # Long-context path: non-causal ring attention — sequence
-            # sharded over the `context` axis, KV rotating on the ICI ring.
-            # Exact attention (online softmax); attention-prob dropout is
-            # unavailable here, residual dropout remains.
+            # sharded over the `context` axis, KV (and the key mask)
+            # rotating on the ICI ring.  Exact attention (online softmax);
+            # attention-prob dropout is unavailable here, residual dropout
+            # remains.
             ctx = ring_attention(
                 q, k, v, mesh=self.mesh, causal=False,
                 chunk_size=cfg.ring_chunk_size or None,
+                kv_mask=input_mask,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
-            ctx = flash_attention(q, k, v, causal=False).reshape(B, T, d)
+            ctx = flash_attention(
+                q, k, v, causal=False, kv_mask=input_mask
+            ).reshape(B, T, d)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            if input_mask is not None:
+                # Key-only padding mask (TF attention_mask semantics):
+                # padded keys never receive probability; padded queries'
+                # rows are garbage the loss never reads.
+                scores = jnp.where(
+                    (input_mask > 0)[:, None, None, :], scores,
+                    jnp.finfo(scores.dtype).min,
+                )
             probs = jax.nn.softmax(
                 scores.astype(jnp.float32), -1
             ).astype(cfg.dtype)
@@ -136,6 +155,9 @@ class BertPretrain(nn.Module):
         segment_ids = batch.get(
             "segment_ids", jnp.zeros_like(tokens)
         )
+        # Key-validity mask from the batch (variable-length padded inputs);
+        # absent means all tokens are real (fixed-length synthetic batches).
+        input_mask = batch.get("input_mask")
         B, T = tokens.shape
         word = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=jnp.float32,
                         name="word_embeddings")
@@ -155,18 +177,19 @@ class BertPretrain(nn.Module):
                 body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,  # the mask is layer-invariant
                 length=cfg.n_layer,
             )
             x, _ = Scanned(
                 cfg, mesh=self.mesh, deterministic=deterministic,
                 name="layers",
-            )(x)
+            )(x, input_mask)
         else:
             for i in range(cfg.n_layer):
                 x, _ = EncoderLayer(
                     cfg, mesh=self.mesh, deterministic=deterministic,
                     name=f"layer_{i}",
-                )(x)
+                )(x, input_mask)
 
         # MLM head: gather the K prediction positions FIRST (the
         # reference's max_predictions_per_seq format), then transform +
@@ -251,12 +274,19 @@ def make_workload(
     seq_len: int = 128,
     config: Optional[BertConfig] = None,
     ring_chunk_size: Optional[int] = None,
+    use_flash_attention: Optional[bool] = None,
     mesh: Optional[Mesh] = None,
     **_unused,
 ) -> Workload:
     cfg = config or BertConfig.base()
     if ring_chunk_size is not None:
         cfg = dataclasses.replace(cfg, ring_chunk_size=ring_chunk_size)
+    if use_flash_attention is None and config is None:
+        # Per-phase default from measurement (see BertConfig): dense for
+        # phase-1 seq 128, flash for phase-2 seq 512.
+        use_flash_attention = seq_len >= 256
+    if use_flash_attention is not None:
+        cfg = dataclasses.replace(cfg, use_flash_attention=use_flash_attention)
     seq = min(seq_len, cfg.max_positions)
     module = BertPretrain(cfg, mesh=mesh)
     # Init batch must divide over the batch-sharding axes when the mesh
@@ -268,6 +298,7 @@ def make_workload(
     K = mlm_max_predictions(seq)
     init_batch = {
         "tokens": np.zeros((b0, seq), np.int32),
+        "input_mask": np.ones((b0, seq), np.int32),
         "mlm_positions": np.zeros((b0, K), np.int32),
         "mlm_targets": np.zeros((b0, K), np.int32),
         "mlm_weights": np.zeros((b0, K), np.float32),

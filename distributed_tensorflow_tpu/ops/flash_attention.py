@@ -17,6 +17,12 @@ Design:
 - Causal masking is positional inside the tile; with ``causal=True`` key
   blocks entirely above the diagonal are skipped by loop bound, not masked —
   ~2x fewer tiles for long sequences.
+- Key padding masks (``kv_mask``, the reference stack's per-op
+  ``attention_mask`` input derived from BERT's ``input_mask``): a (B, Tk)
+  validity row is loaded per program — batch index = program // heads — and
+  each key block's slice zeroes masked keys' probabilities via s = -inf.
+  Only KEYS are masked (TF semantics: the mask broadcasts over queries);
+  padded queries produce garbage rows that the loss never consumes.
 - Backward (FlashAttention-2 schedule, no atomics): two kernels.
   * dQ: grid over query blocks; loops over key blocks, recomputing
     P = exp(S − LSE) per tile from the stored LSE (no (T,T) buffer).
@@ -25,6 +31,11 @@ Design:
   Both compute Δ = rowsum(dO ∘ O) in-kernel from the saved output (cheap
   elementwise on tiles already resident in VMEM) and use
   dS = P ∘ (dP − Δ) · scale.
+- ``flash_attention_with_lse`` returns (out, lse) and is differentiable in
+  BOTH outputs: ∂lse/∂s = P, so the lse cotangent folds into the backward
+  kernels as dS = P ∘ (dP − Δ + g_lse) · scale.  This is the building block
+  ring attention consumes per key block (the per-block lse drives the exact
+  cross-block online-softmax combine).
 - Non-TPU platforms and awkward shapes fall back to the dense XLA path with
   identical numerics (f32 softmax); its backward is XLA autodiff.
 """
@@ -33,7 +44,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -71,21 +82,51 @@ def _interpret() -> bool:
     return os.environ.get("DTT_PALLAS_INTERPRET", "") == "1"
 
 
-def _dense(q, k, v, *, causal, scale):
+def _dense(q, k, v, *, causal, scale, kv_mask=None):
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if kv_mask is not None:
+        scores = jnp.where(
+            (kv_mask > 0)[:, None, None, :], scores, -jnp.inf
+        )
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, seq_len, causal, scale,
-            block_q, block_k, save_lse):
+def _dense_with_lse(q, k, v, *, causal, scale, kv_mask=None):
+    """(out, lse) with plain XLA ops — the differentiable fallback for
+    ``flash_attention_with_lse`` off-TPU.  lse: (B, H, Tq) f32."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if kv_mask is not None:
+        scores = jnp.where(
+            (kv_mask > 0)[:, None, None, :], scores, -jnp.inf
+        )
+    m = jnp.max(scores, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    lse = m_safe + jnp.log(jnp.maximum(l, 1e-30))
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / jnp.maximum(l, 1e-30)[..., None]).astype(v.dtype), v
+    )
+    return out, lse
+
+
+def _kernel(q_ref, k_ref, v_ref, *rest, seq_len, causal, scale,
+            block_q, block_k, save_lse, has_mask):
     from jax.experimental import pallas as pl
 
-    lse_ref = rest[0] if save_lse else None
+    rest = list(rest)
+    mask_ref = rest.pop(0) if has_mask else None
+    o_ref = rest.pop(0)
+    lse_ref = rest.pop(0) if save_lse else None
     qi = pl.program_id(1)
     # Keep matmul operands in the input dtype (bf16 in production): the MXU
     # runs bf16 x bf16 -> f32 at full rate, f32 x f32 at a fraction of it.
@@ -118,6 +159,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, seq_len, causal, scale,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_mask:
+            m_blk = mask_ref[0, :, pl.ds(j * block_k, block_k)]  # (1, block_k)
+            s = jnp.where(m_blk > 0, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - m_safe)
@@ -136,10 +180,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *rest, seq_len, causal, scale,
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     if save_lse:
-        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
+        # Rows with zero valid keys (l == 0) get lse = -1e30, so a
+        # downstream exp(lse - anything) underflows to an exact no-op
+        # contribution (ring attention's cross-block combine relies on it).
+        lse = jnp.where(l > 0, m + jnp.log(l_safe), -1e30)
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, LANES))
 
 
 def _to_heads(x):
@@ -152,7 +200,7 @@ def _from_heads(x, B, H):
     return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_tpu(q, k, v, *, causal, scale, save_lse):
+def _flash_fwd_tpu(q, k, v, kv_mask, *, causal, scale, save_lse):
     """Returns out (B,T,H,D), and lse (B·H, T, LANES) f32 if save_lse."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -160,8 +208,23 @@ def _flash_fwd_tpu(q, k, v, *, causal, scale, save_lse):
     B, T, H, D = q.shape
     block_q = _fit_block(T, BLOCK_Q)
     block_k = _fit_block(T, BLOCK_K)
+    has_mask = kv_mask is not None
     qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
     grid = (B * H, pl.cdiv(T, block_q))
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+    ]
+    operands = [qh, kh, vh]
+    if has_mask:
+        # One (1, 1, Tk) validity row per program; batch index = program
+        # // H.  The leading singleton keeps the block's last two dims
+        # equal to the array dims (Mosaic's tiling requirement — a (1, Tk)
+        # 2D block has an un-tileable sublane dim of 1).
+        in_specs.append(
+            pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0)))
+        operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
     out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
     if save_lse:
@@ -173,29 +236,31 @@ def _flash_fwd_tpu(q, k, v, *, causal, scale, save_lse):
         functools.partial(
             _kernel, seq_len=T, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, save_lse=save_lse,
+            has_mask=has_mask,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(qh, kh, vh)
+    )(*operands)
     if save_lse:
         return _from_heads(res[0], B, H), res[1]
     return _from_heads(res[0], B, H), None
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
-                   *, seq_len, causal, scale, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
+                   seq_len, causal, scale, block_q, block_k,
+                   has_mask, has_glse):
     from jax.experimental import pallas as pl
 
+    rest = list(rest)
+    glse_ref = rest.pop(0) if has_glse else None
+    mask_ref = rest.pop(0) if has_mask else None
+    dq_ref = rest.pop(0)
     qi = pl.program_id(1)
     q = q_ref[0]                              # (block_q, D), input dtype
     g = g_ref[0]                              # (block_q, D)
@@ -205,6 +270,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
         g.astype(jnp.float32) * o.astype(jnp.float32),
         axis=-1, keepdims=True,
     )
+    if has_glse:
+        # dS gains + g_lse ∘ P (∂lse/∂s = P): fold into the Δ subtraction.
+        delta = delta - glse_ref[0][:, :1]
     D = q.shape[-1]
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
@@ -229,6 +297,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_mask:
+            m_blk = mask_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(m_blk > 0, s, -jnp.inf)
         p = jnp.exp(s - lse)                  # masked -> exp(-inf) = 0
         dp = jax.lax.dot_general(
             g, v_blk, (((1,), (1,)), ((), ())),
@@ -244,11 +315,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
     dq_ref[0] = jax.lax.fori_loop(0, hi, body, dq0).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
-                    dk_ref, dv_ref,
-                    *, seq_len, causal, scale, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
+                    seq_len, causal, scale, block_q, block_k,
+                    has_mask, has_glse):
     from jax.experimental import pallas as pl
 
+    rest = list(rest)
+    glse_ref = rest.pop(0) if has_glse else None
+    mask_ref = rest.pop(0) if has_mask else None
+    dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     k = k_ref[0]                              # (block_k, D), input dtype
     v = v_ref[0]                              # (block_k, D)
@@ -260,6 +335,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
         lo = (ki * block_k) // block_q
     else:
         lo = 0
+    if has_mask:
+        my_mask = mask_ref[0, :, pl.ds(ki * block_k, block_k)]  # (1, block_k)
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -271,6 +348,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
             g_blk.astype(jnp.float32) * o_blk.astype(jnp.float32),
             axis=-1, keepdims=True,
         )
+        if has_glse:
+            delta = delta - glse_ref[0, pl.ds(i * block_q, block_q), :1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -283,6 +362,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_mask:
+            s = jnp.where(my_mask > 0, s, -jnp.inf)
         p = jnp.exp(s - lse)
         # dV += P^T dO
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -307,48 +388,72 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref,
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, o, lse, g, *, causal, scale):
+def _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, g_lse, *, causal, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, D = q.shape
     block_q = _fit_block(T, BLOCK_Q)
     block_k = _fit_block(T, BLOCK_K)
+    has_mask = kv_mask is not None
+    has_glse = g_lse is not None
     qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
     gh, oh = _to_heads(g), _to_heads(o)
 
     common = dict(seq_len=T, causal=causal, scale=scale,
-                  block_q=block_q, block_k=block_k)
+                  block_q=block_q, block_k=block_k,
+                  has_mask=has_mask, has_glse=has_glse)
+
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # k
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # v
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # o
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # g
+        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+    ]
+    dq_operands = [qh, kh, vh, oh, gh, lse]
+    if has_glse:
+        dq_in_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)))
+        dq_operands.append(g_lse)
+    if has_mask:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0)))
+        dq_operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(B * H, pl.cdiv(T, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # k
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # v
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # o
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # g
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(qh, kh, vh, oh, gh, lse)
+    )(*dq_operands)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # q
+        pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # o
+        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # g
+        pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)),     # lse
+    ]
+    dkv_operands = [qh, kh, vh, oh, gh, lse]
+    if has_glse:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)))
+        dkv_operands.append(g_lse)
+    if has_mask:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, T), lambda b, j: (b // H, 0, 0)))
+        dkv_operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(B * H, pl.cdiv(T, block_k)),
-        in_specs=[
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # q
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # v
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # o
-            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # g
-            pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)),     # lse
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
@@ -361,7 +466,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, *, causal, scale):
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(qh, kh, vh, oh, gh, lse)
+    )(*dkv_operands)
 
     return (_from_heads(dq, B, H), _from_heads(dk, B, H),
             _from_heads(dv, B, H))
@@ -373,39 +478,87 @@ def _supported(q, causal):
         return False
     if _fit_block(T, BLOCK_Q) is None or _fit_block(T, BLOCK_K) is None:
         return False
+    # The backward kernels keep full-T q/o/g/lse windows resident per
+    # program; at T = 8192 with H >= 8 the Mosaic compiler aborts (VMEM
+    # window allocation; measured on v5e 2026-07-30 — T=6144 x 16 heads
+    # compiles, 8192 x 8 does not).  Reject so callers get the dense /
+    # ring-chunked fallback instead of an INTERNAL compile error; sequences
+    # this long belong on the ring path (sharded to <= 4k per chip) anyway.
+    if T > 6144 and not _interpret():
+        return False
     return D in (64, 128, 256) or D % 128 == 0 or _interpret()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kv_mask, causal, scale):
     if _supported(q, causal):
-        out, _ = _flash_fwd_tpu(q, k, v, causal=causal, scale=scale,
+        out, _ = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
                                 save_lse=False)
         return out
-    return _dense(q, k, v, causal=causal, scale=scale)
+    return _dense(q, k, v, causal=causal, scale=scale, kv_mask=kv_mask)
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, kv_mask, causal, scale):
     if _supported(q, causal):
-        out, lse = _flash_fwd_tpu(q, k, v, causal=causal, scale=scale,
-                                  save_lse=True)
-        return out, (q, k, v, out, lse)
-    return _dense(q, k, v, causal=causal, scale=scale), (q, k, v, None, None)
+        out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal,
+                                  scale=scale, save_lse=True)
+        return out, (q, k, v, kv_mask, out, lse)
+    return (_dense(q, k, v, causal=causal, scale=scale, kv_mask=kv_mask),
+            (q, k, v, kv_mask, None, None))
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
+    q, k, v, kv_mask, o, lse = res
     if o is None:
         # Fallback path (non-TPU / awkward shapes): XLA autodiff of dense.
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale),
+            lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale,
+                                      kv_mask=kv_mask),
             q, k, v,
         )
-        return vjp(g)
-    return _flash_bwd_tpu(q, k, v, o, lse, g, causal=causal, scale=scale)
+        return vjp(g) + (None,)
+    dq, dk, dv = _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, None,
+                                causal=causal, scale=scale)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _lse_to_bht(lse_lanes, B, H):
+    """(B·H, T, LANES) broadcast layout -> (B, H, T) value layout."""
+    BH, T, _ = lse_lanes.shape
+    return lse_lanes[:, :, 0].reshape(B, H, T)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_lse(q, k, v, kv_mask, causal, scale):
+    out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
+                              save_lse=True)
+    return out, _lse_to_bht(lse, q.shape[0], q.shape[2])
+
+
+def _flash_lse_fwd(q, k, v, kv_mask, causal, scale):
+    out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
+                              save_lse=True)
+    return ((out, _lse_to_bht(lse, q.shape[0], q.shape[2])),
+            (q, k, v, kv_mask, out, lse))
+
+
+def _flash_lse_bwd(causal, scale, res, cts):
+    q, k, v, kv_mask, o, lse = res
+    g_out, g_lse = cts
+    B, T, H, D = q.shape
+    # (B, H, T) -> the kernels' (B·H, T, LANES) broadcast layout.
+    g_lse_lanes = jnp.broadcast_to(
+        g_lse.astype(jnp.float32).reshape(B * H, T, 1), (B * H, T, LANES)
+    )
+    dq, dk, dv = _flash_bwd_tpu(q, k, v, o, lse, g_out, kv_mask, g_lse_lanes,
+                                causal=causal, scale=scale)
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(
@@ -415,8 +568,39 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D).
+
+    ``kv_mask``: optional (B, Tk) key-validity mask (>0 = real token) — the
+    reference stack's per-op ``attention_mask`` input (BERT ``input_mask``
+    semantics: masks KEYS only, broadcasting over queries).
+    """
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    return _flash(q, k, v, causal, scale)
+    return _flash(q, k, v, kv_mask, causal, scale)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused attention returning (out, lse); differentiable in both.
+
+    out: (B, T, H, D); lse: (B, H, T) f32 per-row logsumexp of the scaled
+    scores.  The building block for ring attention's cross-block combine:
+    out_total = Σ_blocks out_b · exp(lse_b − logsumexp_b lse_b) is exact.
+    Rows with zero valid keys yield out = 0, lse = -1e30 (an exact no-op
+    under that combine).
+    """
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if _supported(q, causal):
+        return _flash_lse(q, k, v, kv_mask, causal, scale)
+    return _dense_with_lse(q, k, v, causal=causal, scale=scale,
+                           kv_mask=kv_mask)

@@ -6,18 +6,28 @@ accumulation), so this is net-new capability, built the TPU way:
 
 - The sequence axis is sharded over the ``context`` mesh axis; each chip
   holds Q/K/V blocks of length T/N.
-- K/V blocks rotate around the ICI ring via ``lax.ppermute`` (HLO
-  CollectivePermute — a neighbor DMA, the cheapest collective on a torus)
-  while each chip accumulates its queries' attention over every block —
-  compute and transfer overlap across ring steps.
-- Numerics: blockwise *online softmax* (running max + running denominator,
-  flash-attention style) in f32, so the result is exact attention, not an
-  approximation, for any number of ring steps.
-- Causal masking is positional: block owner index × block length gives each
-  key's global position; masking happens inside the block computation.
-
-The per-block computation is a plain einsum (XLA fuses it well); swap in
-``ops.flash_attention`` for the fused-VMEM Pallas version where profitable.
+- K/V blocks (and the key-validity mask, when given) rotate around the ICI
+  ring via ``lax.ppermute`` (HLO CollectivePermute — a neighbor DMA, the
+  cheapest collective on a torus) while each chip accumulates its queries'
+  attention over every block — compute and transfer overlap across ring
+  steps.
+- Numerics: per-block attention yields (out_b, lse_b); blocks merge with the
+  exact log-sum-exp combine  out = Σ_b out_b · exp(lse_b − lse_total),
+  accumulated online in f32 — exact attention, not an approximation, for
+  any number of ring steps.
+- The per-block computation is the Pallas flash kernel
+  (``ops.flash_attention_with_lse``) whenever the per-shard shape supports
+  it: the (T/N, T/N) score tile then lives in VMEM feeding the MXU instead
+  of materializing in HBM as the einsum formulation does.  Off-TPU (and for
+  unsupported shapes) the einsum path below is the fallback, optionally
+  kv-chunked to bound memory.
+- Causality is resolved at the BLOCK level, not by in-kernel offsets: every
+  kv block is either entirely below this chip's queries (attend,
+  causal=False), the diagonal block (attend, causal=True — local positions
+  align), or entirely above (skip: contribute out=0, lse=-1e30, an exact
+  no-op under the lse combine).  ``lax.cond`` picks per ring step, so
+  above-diagonal blocks cost no FLOPs — the same tile-skipping the flash
+  kernel does internally, lifted to ring granularity.
 """
 
 from __future__ import annotations
@@ -30,12 +40,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_tensorflow_tpu.ops.flash_attention import (
+    _dense,
+    _supported,
+    flash_attention_with_lse,
+)
 
-def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale):
+
+def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale,
+                  kv_mask=None):
     """One (q-block × kv-block) partial attention with positional masking.
 
-    q: (B, Tq, H, D); k/v: (B, Tk, H, D).  Returns (scores-weighted values,
-    running max, running denom) pieces in f32:
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); kv_mask: optional (B, Tk) key
+    validity.  Returns (scores-weighted values, running max, running denom)
+    pieces in f32:
       partial: (B, Tq, H, D), m: (B, H, Tq), l: (B, H, Tq)
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
@@ -45,6 +63,8 @@ def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale):
         k_pos = k_offset + jnp.arange(Tk)
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if kv_mask is not None:
+        scores = jnp.where((kv_mask > 0)[:, None, None, :], scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)  # (B, H, Tq)
     # All-masked rows (early q positions vs late kv blocks): exp(-inf - -inf)
     # is nan; pin m to 0 there so p == 0 and nothing accumulates.
@@ -70,7 +90,7 @@ def _combine(acc, l_acc, m_acc, partial, l_new, m_new):
 
 
 def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
-                          chunk):
+                          chunk, kv_mask=None):
     """``_block_attend`` with the kv block processed in ``chunk``-sized
     pieces under a scan: the (Tq, Tk) score tile never materializes —
     only (Tq, chunk) — bounding per-ring-step memory for long per-shard
@@ -88,9 +108,11 @@ def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
         acc, l_acc, m_acc = carry
         k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
         v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        m_c = (None if kv_mask is None else
+               lax.dynamic_slice_in_dim(kv_mask, i * chunk, chunk, axis=1))
         partial, m_new, l_new = _block_attend(
             q, k_c, v_c, q_offset=q_offset, k_offset=k_offset + i * chunk,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, kv_mask=m_c,
         )
         acc, l_acc, m_acc = _combine(acc, l_acc, m_acc, partial, l_new, m_new)
         return (acc, l_acc, m_acc), None
@@ -122,70 +144,139 @@ def ring_attention(
     causal: bool = True,
     batch_axes: tuple = ("data", "fsdp"),
     chunk_size: Optional[int] = None,
+    kv_mask: Optional[jax.Array] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q, k, v: (B, T, H, D) global arrays, T sharded over ``axis``.
+    kv_mask: optional (B, T) key-validity mask (>0 = real token), sharded
+    like the keys; rotates around the ring with them (BERT ``input_mask``
+    semantics — keys masked, queries not).
     Returns (B, T, H, D), sharded like q.
 
-    ``chunk_size`` bounds per-ring-step memory: each arriving kv block is
-    consumed in chunks of that many keys, so the biggest score tile is
-    (T/N, chunk_size) instead of (T/N, T/N) — at pod-scale sequence
-    lengths (e.g. 8k per shard) the difference between fitting in HBM and
-    not.  None processes whole blocks (fastest for short shards).
+    ``use_flash`` selects the per-block engine: None = auto (Pallas flash
+    kernel when the per-shard shape supports it — TPU or interpreter),
+    False = einsum blocks.  ``chunk_size`` bounds per-ring-step memory on
+    the einsum path only: each arriving kv block is consumed in chunks of
+    that many keys, so the biggest score tile is (T/N, chunk_size) — the
+    flash path needs no chunking (its score tiles live in VMEM).
     """
     n = mesh.shape.get(axis, 1)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     if n == 1:
-        return _dense_attention(q, k, v, causal=causal, scale=scale)
+        return _dense_attention(q, k, v, causal=causal, scale=scale,
+                                kv_mask=kv_mask)
 
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     spec = P(batch, axis)
+    if use_flash is None:
+        # Per-shard shapes decide support (shard_map hands _local blocks).
+        B, T, H, D = q.shape
+        shard_q = jax.ShapeDtypeStruct((B, T // n, H, D), q.dtype)
+        use_flash = _supported(shard_q, causal)
 
-    def _local(q_blk, k_blk, v_blk):
+    def _local(q_blk, k_blk, v_blk, mask_blk):
         B, Tq, H, D = q_blk.shape
         my = lax.axis_index(axis)
         q_off = my * Tq
+        perm = [(j, (j - 1) % n) for j in range(n)]
 
-        def step(carry, i):
-            acc, l_acc, m_acc, k_cur, v_cur = carry
+        def step_flash(carry, i):
+            acc, lse_acc, k_cur, v_cur, m_cur = carry
             # kv block currently held arrived from neighbor `my + i` (ring
             # shifts move blocks to lower indices each step).
             owner = (my + i) % n
-            if chunk_size is not None and chunk_size < k_cur.shape[1]:
-                partial, m_new, l_new = _block_attend_chunked(
-                    q_blk, k_cur, v_cur,
-                    q_offset=q_off, k_offset=owner * Tq,
-                    causal=causal, scale=scale, chunk=chunk_size,
+
+            def attend(is_causal):
+                def f(op):
+                    k_c, v_c, m_c = op
+                    out_b, lse_b = flash_attention_with_lse(
+                        q_blk, k_c, v_c, causal=is_causal, scale=scale,
+                        kv_mask=m_c,
+                    )
+                    return out_b.astype(jnp.float32), lse_b
+                return f
+
+            def skip(op):
+                return (jnp.zeros((B, Tq, H, D), jnp.float32),
+                        jnp.full((B, H, Tq), -1e30, jnp.float32))
+
+            op = (k_cur, v_cur, m_cur)
+            if causal:
+                # diagonal: local positions align, the kernel's own causal
+                # masking is exact; below-diagonal: fully visible; above:
+                # fully masked -> skip the kernel entirely.
+                out_b, lse_b = lax.cond(
+                    owner == my,
+                    attend(True),
+                    lambda o: lax.cond(owner < my, attend(False), skip, o),
+                    op,
                 )
             else:
-                partial, m_new, l_new = _block_attend(
-                    q_blk, k_cur, v_cur,
-                    q_offset=q_off, k_offset=owner * Tq,
-                    causal=causal, scale=scale,
-                )
-            acc, l_acc, m_acc = _combine(acc, l_acc, m_acc,
-                                         partial, l_new, m_new)
-            # rotate kv around the ring (neighbor DMA on ICI)
-            perm = [(j, (j - 1) % n) for j in range(n)]
+                out_b, lse_b = attend(False)(op)
+            # Exact cross-block combine in log space.
+            lse_new = jnp.logaddexp(lse_acc, lse_b)
+            w_old = jnp.moveaxis(jnp.exp(lse_acc - lse_new), 1, 2)[..., None]
+            w_new = jnp.moveaxis(jnp.exp(lse_b - lse_new), 1, 2)[..., None]
+            acc = acc * w_old + out_b * w_new
             k_nxt = lax.ppermute(k_cur, axis, perm)
             v_nxt = lax.ppermute(v_cur, axis, perm)
-            return (acc, l_acc, m_acc, k_nxt, v_nxt), None
+            m_nxt = (None if m_cur is None
+                     else lax.ppermute(m_cur, axis, perm))
+            return (acc, lse_new, k_nxt, v_nxt, m_nxt), None
 
+        def step_einsum(carry, i):
+            acc, l_acc, m_acc, k_cur, v_cur, msk_cur = carry
+            owner = (my + i) % n
+            kw = dict(q_offset=q_off, k_offset=owner * Tq,
+                      causal=causal, scale=scale, kv_mask=msk_cur)
+            if chunk_size is not None and chunk_size < k_cur.shape[1]:
+                partial, m_new, l_new = _block_attend_chunked(
+                    q_blk, k_cur, v_cur, chunk=chunk_size, **kw)
+            else:
+                partial, m_new, l_new = _block_attend(
+                    q_blk, k_cur, v_cur, **kw)
+            acc, l_acc, m_acc = _combine(acc, l_acc, m_acc,
+                                         partial, l_new, m_new)
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            msk_nxt = (None if msk_cur is None
+                       else lax.ppermute(msk_cur, axis, perm))
+            return (acc, l_acc, m_acc, k_nxt, v_nxt, msk_nxt), None
+
+        if use_flash:
+            init = (
+                jnp.zeros((B, Tq, H, D), jnp.float32),
+                jnp.full((B, H, Tq), -1e30, jnp.float32),
+                k_blk, v_blk, mask_blk,
+            )
+            (acc, lse_acc, _, _, _), _ = lax.scan(
+                step_flash, init, jnp.arange(n))
+            # acc is already the exact normalized output (per-block outs
+            # are normalized; the lse weights sum to 1).
+            return acc.astype(q_blk.dtype)
         init = (
             jnp.zeros((B, Tq, H, D), jnp.float32),
             jnp.zeros((B, H, Tq), jnp.float32),
-            jnp.full((B, H, Tq), -jnp.inf, jnp.float32),
+            jnp.full((B, H, Tq), -1e30, jnp.float32),
+            k_blk, v_blk, mask_blk,
         )
-        # pin -inf init max to finite for the first combine
-        init = (init[0], init[1], jnp.full((B, H, Tq), -1e30, jnp.float32),
-                k_blk, v_blk)
-        (acc, l_acc, _, _, _), _ = lax.scan(step, init, jnp.arange(n))
+        (acc, l_acc, _, _, _, _), _ = lax.scan(step_einsum, init,
+                                               jnp.arange(n))
         out = acc / jnp.maximum(jnp.moveaxis(l_acc, 1, 2), 1e-30)[..., None]
         return out.astype(q_blk.dtype)
 
+    if kv_mask is not None:
+        return jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P(batch, axis)),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v, kv_mask.astype(jnp.int32))
     return jax.shard_map(
-        _local,
+        functools.partial(_local, mask_blk=None),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -193,11 +284,6 @@ def ring_attention(
     )(q, k, v)
 
 
-def _dense_attention(q, k, v, *, causal, scale):
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+# The n==1 fallback and the tests' reference implementation: one shared
+# masked-dense body lives in ops.flash_attention.
+_dense_attention = _dense

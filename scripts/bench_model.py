@@ -1,0 +1,99 @@
+"""Per-model throughput bench for the BASELINE.md ladder (BERT / GPT-2 /
+wide_deep rows; the driver's bench.py owns the ResNet-50 north-star line).
+
+Times the jitted train step on one cached device batch (input excluded, same
+contract as bench.py's default mode) and prints one JSON line:
+
+    python scripts/bench_model.py --model=bert --seq_len=128 --batch_size=128
+    python scripts/bench_model.py --model=bert --seq_len=512 --batch_size=32 \
+        --flash_attention
+    python scripts/bench_model.py --model=gpt2 --batch_size=16 \
+        --grad_accum_steps=1 --flash_attention
+
+The unit is examples/sec/chip (seq/s for BERT, sequences for GPT-2 — fixed
+seq_len makes tok/s = value * seq_len).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--seq_len", type=int, default=128)
+    ap.add_argument("--grad_accum_steps", type=int, default=1)
+    ap.add_argument("--flash_attention", action="store_true")
+    ap.add_argument("--no_flash_attention", action="store_true",
+                    help="force flash OFF (absent both flags, the "
+                         "workload's own default applies, e.g. BERT's "
+                         "per-phase auto)")
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.data import per_host_batch_size
+    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+    from distributed_tensorflow_tpu.models import get_workload
+    from distributed_tensorflow_tpu.train_lib import build_state_and_step
+    from distributed_tensorflow_tpu.training import BF16
+
+    n_dev = jax.device_count()
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=n_dev))
+    wl = get_workload(
+        args.model,
+        batch_size=args.batch_size * n_dev,
+        seq_len=args.seq_len,
+        grad_accum_steps=args.grad_accum_steps,
+        use_flash_attention=(False if args.no_flash_attention
+                             else (args.flash_attention or None)),
+        mesh=mesh,
+    )
+    state, state_sh, train_step, batch_sh = build_state_and_step(
+        wl, mesh, precision=BF16, grad_accum_steps=args.grad_accum_steps,
+        total_steps=args.warmup + args.iters,
+    )
+    host_iter = wl.data_fn(per_host_batch_size(wl.batch_size))
+    batch = next(make_global_batches(host_iter, batch_sh[wl.example_key]))
+    rng = jax.random.key(0)
+
+    for _ in range(args.warmup):
+        state, metrics = train_step(state, batch, rng)
+    # Scalar-pull fence (see bench.py): block_until_ready does not actually
+    # block through the axon tunnel.
+    jax.device_get(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, metrics = train_step(state, batch, rng)
+    jax.device_get(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    ex_per_sec = args.iters * wl.batch_size / dt
+    print(json.dumps({
+        "model": args.model,
+        "seq_len": args.seq_len,
+        "batch_per_chip": args.batch_size,
+        "flash": ("off" if args.no_flash_attention else
+                  "on" if args.flash_attention else "workload-default"),
+        "grad_accum_steps": args.grad_accum_steps,
+        "examples_per_sec_per_chip": round(ex_per_sec / n_dev, 1),
+        "tokens_per_sec_per_chip": round(ex_per_sec * args.seq_len / n_dev),
+        "step_ms": round(1000 * dt / args.iters, 2),
+        "loss": float(jax.device_get(metrics["loss"])),
+        "devices": n_dev,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -97,13 +97,16 @@ def main():
             data_iter = itertools.repeat(next(it))
         rng = jax.random.key(0)
         for i in range(args.warmup):
-            state, _ = step(state, next(data_iter), jax.random.fold_in(rng, i))
-        jax.block_until_ready(state.params)
+            state, m = step(state, next(data_iter), jax.random.fold_in(rng, i))
+        if args.warmup:
+            # Scalar-pull fence (see bench.py): block_until_ready does not
+            # actually block through the axon tunnel.
+            jax.device_get(m["loss"])
         t0 = time.perf_counter()
         for i in range(args.iters):
-            state, _ = step(state, next(data_iter),
+            state, m = step(state, next(data_iter),
                             jax.random.fold_in(rng, 99 + i))
-        jax.block_until_ready(state.params)
+        jax.device_get(m["loss"])
         dt = time.perf_counter() - t0
         close = getattr(data_iter, "close", None)
         if callable(close):

@@ -130,6 +130,91 @@ class TestFlashAttention:
                 err_msg=f"{name} mismatch (causal={causal})",
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kv_mask_matches_dense_fwd_and_bwd(self, monkeypatch, causal):
+        """Key padding mask (BERT input_mask semantics) through the fused
+        kernels: fwd + dq/dk/dv vs XLA autodiff of masked dense.  Multi-
+        block so masked keys land in interior tiles, with one row masked
+        below a block boundary."""
+        monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa_mod, "BLOCK_Q", 128)
+        monkeypatch.setattr(fa_mod, "BLOCK_K", 128)
+        from distributed_tensorflow_tpu.ops import flash_attention
+        from distributed_tensorflow_tpu.ops.flash_attention import _dense
+
+        q, k, v = make_qkv(B=2, T=384, H=2, D=16, seed=13)
+        lens = np.array([300, 100])  # one crosses a 128-block boundary
+        mask = jnp.asarray(
+            (np.arange(384)[None, :] < lens[:, None]).astype(np.int32))
+        g = jnp.asarray(
+            np.random.RandomState(17).randn(*q.shape).astype(np.float32))
+        scale = 1 / np.sqrt(q.shape[-1])
+
+        def run(fn):
+            out, vjp = jax.vjp(fn, q, k, v)
+            return (out,) + vjp(g)
+
+        got = run(lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, causal=causal, kv_mask=mask))
+        want = run(lambda q_, k_, v_: _dense(
+            q_, k_, v_, causal=causal, scale=scale, kv_mask=mask))
+        for name, a, b in zip(("out", "dq", "dk", "dv"), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} mismatch (causal={causal})",
+            )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_with_lse_matches_dense_and_lse_cotangent(self, monkeypatch,
+                                                      causal):
+        """flash_attention_with_lse: (out, lse) parity AND gradient parity
+        when the loss consumes BOTH outputs (the lse cotangent feeds the
+        dS = P(dP - Δ + g_lse) term ring attention's combine depends on)."""
+        monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "distributed_tensorflow_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa_mod, "BLOCK_Q", 128)
+        monkeypatch.setattr(fa_mod, "BLOCK_K", 128)
+        from distributed_tensorflow_tpu.ops.flash_attention import (
+            _dense_with_lse,
+            flash_attention_with_lse,
+        )
+
+        q, k, v = make_qkv(B=2, T=256, H=2, D=16, seed=19)
+        rng = np.random.RandomState(23)
+        wo = jnp.asarray(rng.randn(*q.shape).astype(np.float32))
+        wl = jnp.asarray(rng.randn(2, 2, 256).astype(np.float32))
+        scale = 1 / np.sqrt(q.shape[-1])
+
+        out, lse = flash_attention_with_lse(q, k, v, causal=causal)
+        ro, rl = _dense_with_lse(q, k, v, causal=causal, scale=scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(rl),
+                                   rtol=2e-5, atol=2e-5)
+
+        def loss(fn):
+            def f(q_, k_, v_):
+                o, l = fn(q_, k_, v_)
+                return jnp.sum(o * wo) + jnp.sum(l * wl)
+            return f
+
+        got = jax.grad(loss(lambda *xs: flash_attention_with_lse(
+            *xs, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss(lambda *xs: _dense_with_lse(
+            *xs, causal=causal, scale=scale)), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip(("dq", "dk", "dv"), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} mismatch (causal={causal})",
+            )
+
     def test_fused_backward_bf16(self, monkeypatch):
         """bf16 inputs (the training dtype): kernels accumulate f32, so the
         result should track the dense-bf16 path within bf16 tolerance."""

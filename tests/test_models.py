@@ -309,6 +309,92 @@ class TestBert:
         l_cp = [m["loss"] for m in run_steps(make(mesh_4d), mesh_4d, 3)[1]]
         np.testing.assert_allclose(l_dp, l_cp, rtol=2e-2)
 
+    def test_masked_paths_agree(self, mesh_4d, monkeypatch):
+        """VERDICT r2 #1 done-criterion: with variable-length masked
+        batches, the dense, flash (interpreter), and ring attention paths
+        produce the same loss and gradients (f32, so exact)."""
+        import dataclasses
+
+        monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        from distributed_tensorflow_tpu.data.pipeline import synthetic_mlm
+        from distributed_tensorflow_tpu.models.bert import (
+            BertConfig,
+            BertPretrain,
+            _loss_fn,
+        )
+
+        cfg = BertConfig.tiny(dtype=jnp.float32)
+        batch = next(synthetic_mlm(batch_size=8, seq_len=64, vocab_size=256))
+        lengths = batch["input_mask"].sum(1)
+        assert lengths.min() < 64, "variable lengths expected"
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        params = BertPretrain(cfg).init(jax.random.key(0), batch)["params"]
+
+        def loss_for(c, mesh=None):
+            m = BertPretrain(c, mesh=mesh)
+            return lambda p: _loss_fn(m, True, p, batch, None)[0]
+
+        l_dense, g_dense = jax.value_and_grad(loss_for(cfg))(params)
+        l_flash, g_flash = jax.value_and_grad(loss_for(
+            dataclasses.replace(cfg, use_flash_attention=True)))(params)
+        l_ring, g_ring = jax.jit(jax.value_and_grad(
+            loss_for(cfg, mesh_4d)))(params)
+        np.testing.assert_allclose(float(l_dense), float(l_flash), rtol=1e-6)
+        np.testing.assert_allclose(float(l_dense), float(l_ring), rtol=1e-6)
+        for other in (g_flash, g_ring):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+                g_dense, other,
+            )
+
+    def test_synthetic_mlm_mask_invariants(self):
+        """Variable-length batches: mask is a contiguous prefix, padded
+        tokens are 0, and every MLM prediction slot is a valid position."""
+        from distributed_tensorflow_tpu.data.pipeline import synthetic_mlm
+
+        batch = next(synthetic_mlm(batch_size=16, seq_len=64, vocab_size=256))
+        mask = batch["input_mask"]
+        lengths = mask.sum(1)
+        assert lengths.min() >= 32 and lengths.max() <= 64
+        assert len(set(lengths.tolist())) > 1, "lengths should vary"
+        # prefix property
+        assert (mask == (np.arange(64)[None, :] < lengths[:, None])).all()
+        assert (batch["tokens"] * (1 - mask) == 0).all()
+        assert (batch["mlm_positions"] < lengths[:, None]).all()
+        # segments: 0 before the midpoint, 1 from midpoint to length
+        seg = batch["segment_ids"]
+        assert (seg[:, :32] == 0).all()
+        assert (seg * (1 - mask) == 0).all()
+
+    def test_mask_changes_output(self):
+        """Padding must actually be invisible: attention output at valid
+        positions is identical whether padded slots hold zeros or junk."""
+        from distributed_tensorflow_tpu.models.bert import (
+            BertConfig,
+            BertPretrain,
+        )
+
+        cfg = BertConfig.tiny(dtype=jnp.float32)
+        rng = np.random.RandomState(5)
+        T, L = 32, 20
+        base = {
+            "tokens": rng.randint(2, 256, size=(2, T)).astype(np.int32),
+            "input_mask": (np.arange(T)[None, :] < L).astype(np.int32)
+            * np.ones((2, 1), np.int32),
+            "mlm_positions": np.zeros((2, 4), np.int32),
+            "segment_ids": np.zeros((2, T), np.int32),
+        }
+        junk = dict(base)
+        junk["tokens"] = base["tokens"].copy()
+        junk["tokens"][:, L:] = rng.randint(2, 256, size=(2, T - L))
+        module = BertPretrain(cfg)
+        params = module.init(jax.random.key(0), base)["params"]
+        out_base, _ = module.apply({"params": params}, base)
+        out_junk, _ = module.apply({"params": params}, junk)
+        np.testing.assert_allclose(
+            np.asarray(out_base), np.asarray(out_junk), atol=1e-6)
+
     def test_bert_base_param_count(self):
         from distributed_tensorflow_tpu.models.bert import (
             BertConfig,

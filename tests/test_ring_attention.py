@@ -105,6 +105,81 @@ class TestRingAttention:
                 np.asarray(gr), np.asarray(gd), rtol=1e-4, atol=1e-4
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kv_mask_matches_dense(self, mesh_ctx, causal):
+        """Key padding mask rotates around the ring with K/V; result equals
+        masked dense attention (fwd + grads) — einsum block path."""
+        q, k, v = make_qkv(seed=17)
+        T = q.shape[1]
+        lens = np.array([T - 5, T // 2])
+        mask = jnp.asarray(
+            (np.arange(T)[None, :] < lens[:, None]).astype(np.int32))
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        ms = jax.device_put(mask, NamedSharding(mesh_ctx, P(None, "context")))
+        scale = 1.0 / np.sqrt(q.shape[-1])
+
+        got = ring_attention(qs, ks, vs, mesh=mesh_ctx, causal=causal,
+                             kv_mask=ms)
+        want = _dense_attention(q, k, v, causal=causal, scale=scale,
+                                kv_mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+        g_ring = jax.grad(lambda a, b, c: jnp.sum(ring_attention(
+            a, b, c, mesh=mesh_ctx, causal=causal, kv_mask=ms) ** 2),
+            argnums=(0, 1, 2))(qs, ks, vs)
+        g_dense = jax.grad(lambda a, b, c: jnp.sum(_dense_attention(
+            a, b, c, causal=causal, scale=scale, kv_mask=mask) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_flash_blocks_match_dense(self, mesh_ctx, monkeypatch, causal,
+                                      masked):
+        """VERDICT r2 #2 done-criterion: the ring consuming the Pallas
+        flash kernel per block (interpreter on CPU) equals dense attention
+        in fwd AND grads.  Per-shard length 128 = one whole kernel block;
+        causal dispatch (diag/below/skip) and the lse combine are what's
+        under test."""
+        monkeypatch.setenv("DTT_PALLAS_INTERPRET", "1")
+        B, T, H, D = 2, 8 * 128, 2, 16
+        rng = np.random.RandomState(29)
+        q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+                   for _ in range(3))
+        mask = None
+        mask_dev = None
+        if masked:
+            lens = np.array([900, 640])
+            mask = jnp.asarray(
+                (np.arange(T)[None, :] < lens[:, None]).astype(np.int32))
+            mask_dev = jax.device_put(
+                mask, NamedSharding(mesh_ctx, P(None, "context")))
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        scale = 1.0 / np.sqrt(D)
+
+        got = ring_attention(qs, ks, vs, mesh=mesh_ctx, causal=causal,
+                             kv_mask=mask_dev, use_flash=True)
+        want = _dense_attention(q, k, v, causal=causal, scale=scale,
+                                kv_mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+        w = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        g_ring = jax.grad(lambda a, b, c: jnp.sum(ring_attention(
+            a, b, c, mesh=mesh_ctx, causal=causal, kv_mask=mask_dev,
+            use_flash=True) * w), argnums=(0, 1, 2))(qs, ks, vs)
+        g_dense = jax.grad(lambda a, b, c: jnp.sum(_dense_attention(
+            a, b, c, causal=causal, scale=scale, kv_mask=mask) * w),
+            argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_single_device_axis_falls_back(self, mesh_dp):
         # mesh without a context axis (size 1) → dense path
         q, k, v = make_qkv(T=8)

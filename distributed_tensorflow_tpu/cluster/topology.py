@@ -125,19 +125,45 @@ def build_hybrid_mesh(
     layout — cross-slice traffic is only the gradient allreduce).
 
     ``dcn_data_parallelism`` defaults to the number of slices
-    (``device.slice_index`` granularity).  On single-slice / CPU platforms
-    this degrades to ``build_mesh`` exactly.
+    (``device.slice_index`` granularity).  Three granule sources, in order:
+
+    1. TPU pods: ``device.slice_index`` (real DCN slices).
+    2. Multi-process CPU/test clusters: one granule per PROCESS
+       (``process_is_granule`` — the cross-process axis plays DCN, exactly
+       the tier-(c) localhost-cluster topology).
+    3. Single-process with explicit ``dcn_data_parallelism``: contiguous
+       device groups as pseudo-slices (structural: lets the virtual-mesh
+       tests and the driver dryrun execute the hybrid layout's collective
+       pattern without hardware slices).
+
+    On single-slice platforms without an explicit count this degrades to
+    ``build_mesh`` exactly.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    have_slice_ids = any(hasattr(d, "slice_index") for d in devices)
     slice_ids = {getattr(d, "slice_index", 0) for d in devices}
-    n_slices = (dcn_data_parallelism if dcn_data_parallelism is not None
-                else len(slice_ids))
+    n_processes = len({d.process_index for d in devices})
+    if dcn_data_parallelism is not None:
+        n_slices = dcn_data_parallelism
+    elif have_slice_ids:
+        # TPU: the real slice structure (multi-host single-slice pods keep
+        # slice_index == 0 everywhere and correctly degrade to one slice).
+        n_slices = len(slice_ids)
+    else:
+        # CPU test clusters: processes are the only DCN-like boundary.
+        n_slices = n_processes
     if n_slices <= 1:
         return build_mesh(config, devices)
     sizes = config.axis_sizes(len(devices))
     if sizes["data"] % n_slices:
+        if dcn_data_parallelism is None:
+            # Inferred granules that the requested layout cannot span (e.g.
+            # data=1 with fsdp-only parallelism on a 2-process cluster):
+            # keep the documented degrade instead of refusing a layout the
+            # caller never asked to slice.
+            return build_mesh(config, devices)
         raise ValueError(
             f"data axis ({sizes['data']}) must be divisible by the DCN "
             f"slice count ({n_slices}): cross-slice parallelism rides the "
@@ -145,12 +171,27 @@ def build_hybrid_mesh(
         )
     ici_shape = dict(sizes, data=sizes["data"] // n_slices)
     dcn_shape = {a: (n_slices if a == "data" else 1) for a in MESH_AXES}
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_shape[a] for a in MESH_AXES),
-        tuple(dcn_shape[a] for a in MESH_AXES),
-        devices=devices,
-        allow_split_physical_axes=True,
-    )
+    shape = tuple(ici_shape[a] for a in MESH_AXES)
+    dcn = tuple(dcn_shape[a] for a in MESH_AXES)
+    if have_slice_ids and len(slice_ids) == n_slices:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn, devices=devices, allow_split_physical_axes=True,
+        )
+    elif n_processes == n_slices and n_processes > 1:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn, devices=devices, process_is_granule=True,
+            allow_split_physical_axes=True,
+        )
+    else:
+        # Pseudo-slices: contiguous groups, each laid out as one ICI mesh,
+        # stacked along the data axis (granule attrs unavailable).
+        per = len(devices) // n_slices
+        data_ax = MESH_AXES.index("data")
+        groups = []
+        for s in range(n_slices):
+            part = np.array(devices[s * per:(s + 1) * per]).reshape(shape)
+            groups.append(part)
+        dev_array = np.concatenate(groups, axis=data_ax)
     return Mesh(
         dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
     )

@@ -59,6 +59,9 @@ class BertConfig:
     # scan-over-layers + per-layer remat (see GPT2Config for rationale)
     scan_layers: bool = True
     remat: bool = True
+    # nn.scan unroll factor (see GPT2Config.scan_unroll: amortizes the
+    # stacked-grad dynamic-update-slice writes across unrolled layers).
+    scan_unroll: int = 1
     # Pallas fused attention (non-causal); drops attention-prob dropout.
     # Default is per-phase, set by make_workload from measurement (v5e,
     # 2026-07-30, masked batches): dense wins at seq 128 (867 vs 781
@@ -179,6 +182,7 @@ class BertPretrain(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,  # the mask is layer-invariant
                 length=cfg.n_layer,
+                unroll=cfg.scan_unroll,
             )
             x, _ = Scanned(
                 cfg, mesh=self.mesh, deterministic=deterministic,

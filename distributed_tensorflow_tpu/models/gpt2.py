@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -312,6 +313,56 @@ def gpt2_rules() -> ShardingRules:
     )
 
 
+def _guard_dense_attention_memory(cfg, *, seq, batch_size, grad_accum_steps,
+                                  mesh) -> None:
+    """Refuse configs whose DENSE attention would OOM the chip.
+
+    The non-flash path materializes (B, H, T, T) score/prob buffers (f32
+    softmax + bf16 probs, forward AND recomputed in backward under remat).
+    GPT-2 medium at seq 1024, per-chip microbatch 16 measured OOM on a
+    16 GB v5e (BASELINE.md) — silently, deep inside XLA allocation.  Guard
+    here with the actionable fix, instead of an opaque RESOURCE_EXHAUSTED:
+    turn on --flash_attention (streams the tiles through VMEM) or raise
+    --grad_accum_steps (shrinks the microbatch).
+    """
+    if cfg.use_flash_attention:
+        return
+    if mesh is not None:
+        dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        ctx = mesh.shape.get("context", 1)
+        if ctx > 1:
+            return  # ring attention path; no (T, T) buffer
+    else:
+        dp = 1
+    if os.environ.get("DTT_SKIP_DENSE_ATTN_GUARD", "") == "1":
+        return
+    micro = max(1, batch_size // (dp * max(1, grad_accum_steps)))
+    # ~6 live (micro, H, T, T) buffers around the softmax in the remat
+    # backward (f32 scores + probs forward-recomputed, their cotangents,
+    # bf16 probs both ways); calibrated to the measured boundary: medium/
+    # seq-1024 OOMs at microbatch 16 (6.4 GiB by this model) and fits at
+    # microbatch 4 (1.6 GiB) on a 16 GiB v5e.
+    approx_bytes = 6 * micro * cfg.n_head * seq * seq * 4
+    # Budget = 1/4 of device memory (the rest is params/acts/grads).
+    # Bigger-HBM chips (v4/v5p) get a proportionally higher ceiling;
+    # platforms that don't report memory use the 16 GiB v5e assumption.
+    hbm = 16 * 1024**3
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", hbm)) or hbm
+    except Exception:
+        pass
+    budget = hbm // 4
+    if approx_bytes > budget:
+        raise ValueError(
+            f"dense attention at microbatch {micro} x {cfg.n_head} heads x "
+            f"seq {seq} needs ~{approx_bytes / 1024**3:.0f} GiB of (T, T) "
+            "score buffers — this OOMs the chip. Enable --flash_attention "
+            "(streams score tiles through VMEM, no (T, T) buffer) or raise "
+            "--grad_accum_steps to shrink the per-chip microbatch."
+        )
+
+
 def make_workload(
     *,
     preset: str = "medium",
@@ -350,6 +401,10 @@ def make_workload(
             )
             cfg = dataclasses.replace(cfg, dropout=0.0)
     seq = seq_len or min(cfg.n_positions, 1024)
+    _guard_dense_attention_memory(
+        cfg, seq=seq, batch_size=batch_size,
+        grad_accum_steps=grad_accum_steps, mesh=mesh,
+    )
     module = GPT2(cfg, mesh=mesh)
     # Init batch must divide over the batch-sharding axes (ring attention is
     # a shard_map program with static per-shard shapes), like wide_deep.

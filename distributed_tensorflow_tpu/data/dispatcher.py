@@ -1,0 +1,209 @@
+"""Data-service dispatcher tier: N input workers, no single point of failure.
+
+Behavioral model: tf.data service's dispatcher + worker architecture
+($TF/python/data/experimental/service/server_lib.py — SURVEY.md §3.4): a
+small metadata server assigns work, N workers serve bytes, and consumers
+keep training when a worker dies.  TPU-native translation, kept deliberately
+lean:
+
+- ``DataServiceDispatcher``: a tiny TCP metadata server.  Workers register
+  their address; clients fetch the worker list.  It holds NO data and is
+  NOT on the streaming path — after a client has its worker list, the
+  dispatcher can die without affecting training (metadata-plane/data-plane
+  separation, same as tf.data service).
+- Workers are plain ``DataServiceServer``s, each owning ONE record-stripe
+  shard (``shard_index``/``shard_count`` into the native loader), so the
+  union of workers covers the file exactly once per epoch.
+- ``DistributedDataServiceIterator``: connects to every worker and
+  round-robins batches.  A worker that dies mid-stream is dropped with a
+  warning and the remaining workers keep feeding (that shard's un-served
+  records are lost for the epoch — the documented semantics of
+  non-snapshot tf.data service too); only when ALL workers are gone does
+  the trainer see a ``DataServiceError``.
+
+Wire protocol (dispatcher, line-oriented, one request per connection):
+
+    worker -> dispatcher:  ``R <host:port>\n``   -> ``OK\n``
+    client -> dispatcher:  ``L\n``               -> ``<addr> <addr> ...\n``
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Iterator, List, Optional
+
+from distributed_tensorflow_tpu.data.service import (
+    DataServiceError,
+    DataServiceIterator,
+)
+from distributed_tensorflow_tpu.native import RecordFile
+
+logger = logging.getLogger(__name__)
+
+
+class DataServiceDispatcher:
+    """Worker registry (tf.data service dispatcher role, metadata only)."""
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_server((host, port))
+        self._host = host
+        self._port = self._sock.getsockname()[1]
+        self._workers: List[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def target(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    @property
+    def workers(self) -> List[str]:
+        with self._lock:
+            return list(self._workers)
+
+    def start(self) -> "DataServiceDispatcher":
+        self._thread = threading.Thread(
+            target=self._serve, name="dtt-dispatcher", daemon=True)
+        self._thread.start()
+        logger.info("data-service dispatcher at %s", self.target)
+        return self
+
+    def _serve(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                with conn:
+                    conn.settimeout(5)
+                    req = conn.makefile("rb").readline().decode().strip()
+                    if req.startswith("R "):
+                        addr = req[2:].strip()
+                        with self._lock:
+                            if addr not in self._workers:
+                                self._workers.append(addr)
+                        logger.info("dispatcher: registered worker %s", addr)
+                        conn.sendall(b"OK\n")
+                    elif req == "L":
+                        with self._lock:
+                            line = " ".join(self._workers)
+                        conn.sendall(line.encode() + b"\n")
+                    else:
+                        conn.sendall(b"ERR unknown request\n")
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def join(self) -> None:
+        while not self._stop.wait(timeout=1.0):
+            pass
+
+
+def register_worker(dispatcher: str, worker_addr: str,
+                    timeout: float = 10.0) -> None:
+    host, port = dispatcher.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall(f"R {worker_addr}\n".encode())
+        if s.makefile("rb").readline().strip() != b"OK":
+            raise DataServiceError(
+                f"dispatcher at {dispatcher} rejected worker registration")
+
+
+def list_workers(dispatcher: str, timeout: float = 10.0) -> List[str]:
+    host, port = dispatcher.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall(b"L\n")
+        line = s.makefile("rb").readline().decode().strip()
+    return [a for a in line.split() if a]
+
+
+class DistributedDataServiceIterator:
+    """Round-robin consumer over every worker a dispatcher knows.
+
+    Failure semantics: a worker death mid-stream drops that worker (its
+    shard's remaining records are lost for this epoch) and the stream
+    continues; ALL workers dead -> DataServiceError.  Clean end-of-stream
+    from every worker -> StopIteration.
+    """
+
+    def __init__(self, dispatcher: str, record: RecordFile, batch_size: int):
+        self.dispatcher = dispatcher
+        addrs = list_workers(dispatcher)
+        if not addrs:
+            raise DataServiceError(
+                f"dispatcher at {dispatcher} knows no workers — start "
+                "worker processes (data.service --dispatcher=...) first")
+        # Tolerate stale registrations: the dispatcher never prunes dead
+        # workers (a restarted worker re-registers under its new port), so
+        # a list entry that refuses connections must not block the fleet's
+        # live members — the restart-and-resume path depends on it.
+        self._iters = []
+        dead = []
+        for a in addrs:
+            try:
+                self._iters.append(DataServiceIterator(a, record, batch_size))
+            except OSError as e:
+                dead.append(a)
+                logger.warning(
+                    "data-service worker %s unreachable at connect (%s); "
+                    "skipping", a, e)
+        if not self._iters:
+            raise DataServiceError(
+                f"none of dispatcher {dispatcher}'s workers are reachable "
+                f"({dead}); restart the input tier")
+        self._idx = 0
+        self._clean_ends = 0  # shards that finished their epoch normally
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        while self._iters:
+            self._idx %= len(self._iters)
+            it = self._iters[self._idx]
+            try:
+                batch = next(it)
+                self._idx += 1
+                return batch
+            except StopIteration:
+                self._clean_ends += 1
+                it.close()
+                self._iters.pop(self._idx)
+            except DataServiceError as e:
+                logger.warning(
+                    "data-service worker %s lost mid-stream (%s); "
+                    "continuing with %d remaining worker(s)",
+                    it.address, e, len(self._iters) - 1)
+                it.close()
+                self._iters.pop(self._idx)
+        # Every worker is gone.  If ANY shard reached its clean end this is
+        # (possibly partial) end-of-data — worker loss was already tolerated
+        # and warned about, and the outcome must not depend on how deaths
+        # interleave with exhaustion.  Only an all-deaths stream (no clean
+        # end anywhere) is an input outage the trainer should fail on.
+        if self._clean_ends == 0:
+            raise DataServiceError(
+                f"all data-service workers of dispatcher {self.dispatcher} "
+                "died mid-stream; restart the input tier and resume the "
+                "trainer from its checkpoint")
+        raise StopIteration
+
+    def close(self) -> None:
+        for it in self._iters:
+            it.close()
+        self._iters = []

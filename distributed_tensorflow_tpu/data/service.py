@@ -22,13 +22,14 @@ The payload is exactly the loader's batch buffer — no pickling, no
 serialization layer; the client unpacks with ``RecordFile.unpack`` just as
 the in-process path does.
 
-Limitations (deliberate, documented): ONE server per record file — there is
-no dispatcher/replica tier (tf.data service's dispatcher + N workers), so
-the service is a single point of failure for input.  A server death
-mid-stream surfaces in every consumer as ``DataServiceError`` naming the
-service address (not a silent clean end-of-data — the trainer must not
-mistake an input outage for epoch end), and the trainer exits with that
-error; restart-and-resume goes through the normal checkpoint path.
+Failure semantics: a server death mid-stream surfaces in every consumer as
+``DataServiceError`` naming the service address (not a silent clean
+end-of-data — the trainer must not mistake an input outage for epoch end),
+and the trainer exits with that error; restart-and-resume goes through the
+normal checkpoint path.  A STANDALONE server is a single point of failure
+for input; the dispatcher tier (``data/dispatcher.py`` — tf.data service's
+dispatcher + N workers shape) removes it: each worker owns one record
+stripe, consumers round-robin across workers and tolerate worker loss.
 """
 
 from __future__ import annotations
@@ -87,16 +88,24 @@ class DataServiceServer:
         num_threads: int = 2,
         prefetch: int = 8,
         seed: int = 0,
+        shard_index: int = 0,
+        shard_count: int = 1,
     ):
+        if shard_count < 1 or not (0 <= shard_index < shard_count):
+            raise ValueError(
+                f"shard_index must be in [0, shard_count): got "
+                f"shard_index={shard_index}, shard_count={shard_count} "
+                "(shards are 0-based)")
         self.record = record
         self.batch_size = batch_size
-        # The service owns the WHOLE file: shard 0/1 regardless of the
-        # trainer topology (trainers split the stream by pulling, not by
-        # record striping).
+        # Standalone (shard 0/1): the service owns the WHOLE file —
+        # trainers split the stream by pulling, not by record striping.
+        # Under a dispatcher (data/dispatcher.py), each worker owns ONE
+        # record-stripe shard and clients interleave across workers.
         self._loader = NativeRecordLoader(
             path, record, batch_size=batch_size, shuffle=shuffle,
             num_threads=num_threads, prefetch=prefetch, seed=seed,
-            shard_index=0, shard_count=1,
+            shard_index=shard_index, shard_count=shard_count,
         )
         self._loader_lock = threading.Lock()
         self._sock = socket.create_server((host, port))
@@ -276,10 +285,24 @@ class DataServiceIterator:
 
 def data_service_data_fn(address: str, workload):
     """``data_fn``-shaped factory consuming from a data service
-    (the client half of ``--data_service``)."""
+    (the client half of ``--data_service``).
+
+    ``address`` forms: ``host:port`` = one standalone server;
+    ``dispatch://host:port`` = a dispatcher's worker fleet
+    (``data.dispatcher``) consumed round-robin with worker-loss tolerance.
+    """
     from distributed_tensorflow_tpu.data.records import record_schema
 
     def data_fn(per_host_batch_size: int) -> Iterator[dict]:
+        if address.startswith("dispatch://"):
+            from distributed_tensorflow_tpu.data.dispatcher import (
+                DistributedDataServiceIterator,
+            )
+
+            return DistributedDataServiceIterator(
+                address[len("dispatch://"):], record_schema(workload),
+                per_host_batch_size,
+            )
         return DataServiceIterator(
             address, record_schema(workload), per_host_batch_size
         )
@@ -290,8 +313,15 @@ def data_service_data_fn(address: str, workload):
 def main(argv=None):
     """CLI: serve a staged record file.
 
-    python -m distributed_tensorflow_tpu.data.service \
-        --model=mnist --data_dir=/data --batch_size=128 --port=7071
+    Standalone server (whole file):
+        python -m distributed_tensorflow_tpu.data.service \
+            --model=mnist --data_dir=/data --batch_size=128 --port=7071
+    Dispatcher tier (no input SPOF):
+        python -m distributed_tensorflow_tpu.data.service --role=dispatcher
+        python -m distributed_tensorflow_tpu.data.service --model=mnist \
+            --data_dir=/data --batch_size=128 --dispatcher=HOST:PORT \
+            --shard_index=0 --shard_count=2   # one per worker
+        # trainer: --data_service=dispatch://HOST:PORT
     """
     import argparse
 
@@ -299,20 +329,40 @@ def main(argv=None):
         record_path,
         record_schema,
     )
-    from distributed_tensorflow_tpu.models import get_workload
 
     p = argparse.ArgumentParser(description="record-file data service")
-    p.add_argument("--model", required=True)
-    p.add_argument("--data_dir", required=True)
-    p.add_argument("--batch_size", type=int, required=True,
+    p.add_argument("--role", choices=("worker", "dispatcher"),
+                   default="worker")
+    p.add_argument("--model")
+    p.add_argument("--data_dir")
+    p.add_argument("--batch_size", type=int,
                    help="per-trainer-host batch size")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--num_threads", type=int, default=2)
+    p.add_argument("--dispatcher", default=None,
+                   help="worker: register with this dispatcher host:port")
+    p.add_argument("--shard_index", type=int, default=0)
+    p.add_argument("--shard_count", type=int, default=1)
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, force=True)
+    if args.role == "dispatcher":
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+        )
+
+        disp = DataServiceDispatcher(host=args.host, port=args.port).start()
+        print(f"DATA_DISPATCHER_READY {disp.target}", flush=True)
+        disp.join()
+        return
+
+    if not (args.model and args.data_dir and args.batch_size):
+        p.error("--model, --data_dir and --batch_size are required for "
+                "--role=worker")
+    from distributed_tensorflow_tpu.models import get_workload
+
     workload = get_workload(args.model)
     server = DataServiceServer(
         record_path(args.data_dir, args.model),
@@ -322,7 +372,15 @@ def main(argv=None):
         port=args.port,
         seed=args.seed,
         num_threads=args.num_threads,
+        shard_index=args.shard_index,
+        shard_count=args.shard_count,
     ).start()
+    if args.dispatcher:
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            register_worker,
+        )
+
+        register_worker(args.dispatcher, server.target)
     print(f"DATA_SERVICE_READY {server.target}", flush=True)
     server.join()
 

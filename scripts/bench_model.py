@@ -28,6 +28,8 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", required=True)
+    ap.add_argument("--arch", default=None,
+                    help="wide_deep only: wide_deep | dlrm")
     ap.add_argument("--batch_size", type=int, default=128)
     ap.add_argument("--seq_len", type=int, default=128)
     ap.add_argument("--grad_accum_steps", type=int, default=1)
@@ -51,6 +53,9 @@ def main(argv=None):
 
     n_dev = jax.device_count()
     mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=n_dev))
+    kw = {}
+    if args.arch:
+        kw["arch"] = args.arch
     wl = get_workload(
         args.model,
         batch_size=args.batch_size * n_dev,
@@ -59,6 +64,7 @@ def main(argv=None):
         use_flash_attention=(False if args.no_flash_attention
                              else (args.flash_attention or None)),
         mesh=mesh,
+        **kw,
     )
     state, state_sh, train_step, batch_sh = build_state_and_step(
         wl, mesh, precision=BF16, grad_accum_steps=args.grad_accum_steps,

@@ -144,6 +144,119 @@ class TestDataService:
             proc.kill()
             proc.wait(timeout=30)
 
+    def test_dispatcher_workers_cover_one_epoch(self, indexed_record):
+        """Dispatcher tier: two workers each own half the record stripes;
+        a round-robin client sees the whole epoch exactly once."""
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            DistributedDataServiceIterator,
+            register_worker,
+        )
+
+        path, rec, _ = indexed_record
+        disp = DataServiceDispatcher().start()
+        workers = [
+            DataServiceServer(path, rec, batch_size=8, shuffle=False,
+                              num_threads=1, shard_index=i,
+                              shard_count=2).start()
+            for i in range(2)
+        ]
+        try:
+            for w in workers:
+                register_worker(disp.target, w.target)
+            it = DistributedDataServiceIterator(disp.target, rec, 8)
+            labels = []
+            for _ in range(8):  # 64 records / batch 8 = one epoch
+                labels.extend(next(it)["label"].tolist())
+            assert sorted(labels) == list(range(64))
+            it.close()
+        finally:
+            for w in workers:
+                w.stop()
+            disp.stop()
+
+    def test_dispatcher_survives_worker_death(self, tmp_path):
+        """One worker is SIGKILLed mid-stream: the client drops it with a
+        warning and keeps pulling from the survivor; training never sees
+        an error (tf.data-service worker-restart semantics, minus the
+        lost shard's remaining records)."""
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            DistributedDataServiceIterator,
+            register_worker,
+        )
+
+        wl = get_workload("mnist", batch_size=32)
+        path = record_path(str(tmp_path), "mnist")
+        stage_synthetic_to_records(wl, path, 512)
+        rec = record_schema(wl)
+
+        disp = DataServiceDispatcher().start()
+        survivor = DataServiceServer(path, rec, batch_size=32,
+                                     shard_index=0, shard_count=2).start()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        doomed = subprocess.Popen(
+            [sys.executable, "-m", "distributed_tensorflow_tpu.data.service",
+             "--model=mnist", f"--data_dir={tmp_path}", "--batch_size=32",
+             "--shard_index=1", "--shard_count=2"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = doomed.stdout.readline()
+            assert line.startswith("DATA_SERVICE_READY"), line
+            register_worker(disp.target, survivor.target)
+            register_worker(disp.target, line.split()[1])
+
+            it = DistributedDataServiceIterator(disp.target, rec, 32)
+            next(it)  # both live
+            doomed.kill()
+            doomed.wait(timeout=30)
+            # keep pulling well past any buffered batches: the stream must
+            # continue from the survivor, not raise
+            for _ in range(6):
+                b = next(it)
+                assert b["image"].shape[0] == 32
+            it.close()
+        finally:
+            doomed.kill()
+            doomed.wait(timeout=30)
+            survivor.stop()
+            disp.stop()
+
+    def test_train_from_dispatcher(self, tmp_path):
+        """train_lib's --data_service=dispatch://... path end to end: mnist
+        trains from a 2-worker dispatcher fleet."""
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            register_worker,
+        )
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        wl = get_workload("mnist", batch_size=32)
+        path = record_path(str(tmp_path), "mnist")
+        stage_synthetic_to_records(wl, path, 512)
+        rec = record_schema(wl)
+
+        disp = DataServiceDispatcher().start()
+        workers = [
+            DataServiceServer(path, rec, batch_size=32, shard_index=i,
+                              shard_count=2).start()
+            for i in range(2)
+        ]
+        try:
+            for w in workers:
+                register_worker(disp.target, w.target)
+            result = run(TrainArgs(
+                model="mnist", steps=8, batch_size=32, log_every=4,
+                data_service=f"dispatch://{disp.target}",
+            ))
+            assert result["final_step"] == 8
+            assert np.isfinite(result["loss"])
+        finally:
+            for w in workers:
+                w.stop()
+            disp.stop()
+
     def test_out_of_process_server(self, tmp_path):
         """VERDICT #7 done-criterion: a REAL separate server process (the
         CLI) feeds a training run in this process."""

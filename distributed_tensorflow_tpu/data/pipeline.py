@@ -29,12 +29,74 @@ from jax.sharding import NamedSharding
 Batch = Dict[str, np.ndarray]
 
 
+# Stream-sharding override (set by train_lib from the ACTUAL batch layout):
+# None = the default one-shard-per-process policy.  Needed because a
+# multi-process mesh whose batch dim is NOT process-partitioned (e.g. a
+# context-only mesh: batch replicated, sequence sharded) requires every
+# host to feed the SAME stream — per-process decorrelated streams would
+# assemble an inconsistent "replicated" array with no error anywhere.
+_stream_override: Optional[tuple] = None
+
+
+def set_stream_shard_override(num_shards: Optional[int],
+                              index: Optional[int] = None) -> None:
+    """Pin (num_shards, index) for every subsequent ``shard_options()``
+    call in this process; ``set_stream_shard_override(None)`` clears."""
+    global _stream_override
+    _stream_override = None if num_shards is None else (num_shards, index)
+
+
 def shard_options(num_shards: Optional[int] = None, index: Optional[int] = None):
     """The DATA AutoShardPolicy parameters for this host."""
+    if num_shards is None and _stream_override is not None:
+        return _stream_override
     return (
         num_shards if num_shards is not None else jax.process_count(),
         index if index is not None else jax.process_index(),
     )
+
+
+def host_batch_layout(sharding, global_batch_size: int):
+    """(host_rows, num_stream_shards, stream_index) from the REAL layout of
+    the batch dim across processes.
+
+    Derived from ``sharding.devices_indices_map`` on the batch dim: each
+    process feeds exactly the rows its devices own.  Classic DP (batch
+    split over processes) gives (B/P, P, process_index) — identical to
+    ``per_host_batch_size`` + default shard_options.  A batch dim NOT
+    partitioned across processes (context/model-parallel-only meshes)
+    gives (B, 1, 0): every host feeds the full, identical stream.
+    """
+    me = jax.process_index()
+    imap = sharding.devices_indices_map((global_batch_size,))
+    per_proc: Dict[int, set] = {}
+    for d, idx in imap.items():
+        sl = idx[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else global_batch_size
+        per_proc.setdefault(d.process_index, set()).add((start, stop))
+
+    def block(p):
+        spans = sorted(per_proc[p])
+        lo, hi = spans[0][0], spans[-1][1]
+        covered = sum(b - a for a, b in spans)
+        if covered != hi - lo:
+            raise ValueError(
+                f"process {p} owns non-contiguous batch rows {spans} under "
+                f"{sharding}; the host data stream cannot express this "
+                "layout — use a batch sharding whose process blocks are "
+                "contiguous")
+        return lo, hi
+
+    blocks = {p: block(p) for p in per_proc}
+    distinct = sorted(set(blocks.values()))
+    sizes = {b - a for a, b in distinct}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"uneven per-process batch blocks {distinct} under {sharding}; "
+            "the host data stream assumes equal shards")
+    lo, hi = blocks[me]
+    return hi - lo, len(distinct), distinct.index((lo, hi))
 
 
 def per_host_batch_size(global_batch_size: int) -> int:

@@ -83,8 +83,14 @@ def record_data_fn(
     num_threads: int = 2,
     prefetch: int = 4,
     seed: int = 0,
+    shard_index: Optional[int] = None,
+    shard_count: Optional[int] = None,
 ):
-    """A ``data_fn``-shaped factory backed by the native loader."""
+    """A ``data_fn``-shaped factory backed by the native loader.
+
+    ``shard_index``/``shard_count`` default to one stripe per process; pass
+    the values from ``pipeline.host_batch_layout`` when the batch dim is
+    not process-partitioned 1:1 (e.g. replicated on a context-only mesh)."""
 
     def data_fn(per_host_batch_size: int) -> Iterator[dict]:
         loader = NativeRecordLoader(
@@ -95,6 +101,8 @@ def record_data_fn(
             num_threads=num_threads,
             prefetch=prefetch,
             seed=seed,
+            shard_index=shard_index,
+            shard_count=shard_count,
         )
         return iter(loader)
 

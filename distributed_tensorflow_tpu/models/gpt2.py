@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from flax import linen as nn
 from jax.sharding import Mesh
 
@@ -76,6 +77,11 @@ class GPT2Config:
     # per-ring-step score tile to (T/shards, ring_chunk_size) — set for
     # pod-scale per-shard sequence lengths (see parallel.ring_attention).
     ring_chunk_size: int = 0
+    # Cross-entropy chunk length (0 = full (B, T, V) logits).  With a
+    # 50k vocabulary the logits are the step's biggest tensor (batch 24:
+    # 4.9 GiB f32); chunking computes logits+CE per T-chunk under a
+    # rematerialized scan, so only (B, chunk, V) is ever live.
+    ce_chunk: int = 0
 
     @classmethod
     def small(cls, **kw):
@@ -149,7 +155,8 @@ class GPT2(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic: bool = True):
+    def __call__(self, tokens, *, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         B, T = tokens.shape
         wte = self.param(
@@ -199,6 +206,11 @@ class GPT2(nn.Module):
                     name=f"h_{i}",
                 )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            # Chunked-CE path: the loss computes logits per T-chunk itself
+            # (the tied wte comes from the params tree), so the (B, T, V)
+            # buffer never materializes.
+            return x
         # Weight-tied head: bf16 operands on the MXU (f32 runs at half the
         # MXU rate on v5e), f32 accumulation/output for a stable softmax.
         logits = jnp.einsum(
@@ -271,14 +283,57 @@ def _auto_microbatches(batch: int, n_stages: int) -> int:
     )
 
 
+def _chunked_ce(hidden, wte, tokens, chunk, dtype):
+    """Mean next-token CE without materializing (B, T, V) logits.
+
+    Scans T in ``chunk``-length pieces; each step computes that chunk's
+    logits (bf16 MXU operands, f32 accumulation) and its CE, then drops
+    them — ``jax.checkpoint`` makes backward recompute the chunk logits
+    instead of saving them, so peak memory is one (B, chunk, V) tile.
+    """
+    B, T, d = hidden.shape
+    if T % chunk:
+        raise ValueError(f"seq_len {T} not divisible by ce_chunk {chunk}")
+    n = T // chunk
+    # Shifted targets with the final position masked (no next token).
+    tgt = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
+    valid = (jnp.arange(T) < T - 1).astype(jnp.float32)
+    hs = jnp.moveaxis(hidden.reshape(B, n, chunk, d), 1, 0)
+    ts = jnp.moveaxis(tgt.reshape(B, n, chunk), 1, 0)
+    ws = valid.reshape(n, chunk)
+
+    def body(total, xs):
+        h, t, w = xs
+        logits = jnp.einsum(
+            "bcd,vd->bcv", h.astype(dtype), wte.astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t)
+        return total + jnp.sum(ce * w[None, :]), None
+
+    total, _ = lax.scan(
+        jax.checkpoint(body, prevent_cse=False), jnp.float32(0.0),
+        (hs, ts, ws),
+    )
+    return total / (B * (T - 1))
+
+
 def _loss_fn(module: nn.Module, deterministic: bool, params,
              batch: Dict[str, jax.Array], rng):
     tokens = batch["tokens"]
+    cfg = module.cfg
+    rngs = None if deterministic else {"dropout": rng}
+    if cfg.ce_chunk:
+        hidden = module.apply(
+            {"params": params}, tokens, deterministic=deterministic,
+            rngs=rngs, return_hidden=True,
+        )
+        loss = _chunked_ce(hidden, params["wte"], tokens, cfg.ce_chunk,
+                           cfg.dtype)
+        return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
     logits = module.apply(
-        {"params": params},
-        tokens,
-        deterministic=deterministic,
-        rngs=None if deterministic else {"dropout": rng},
+        {"params": params}, tokens, deterministic=deterministic, rngs=rngs,
     )
     # next-token prediction: shift left
     targets = tokens[:, 1:]
@@ -373,6 +428,7 @@ def make_workload(
     mesh: Optional[Mesh] = None,
     use_flash_attention: Optional[bool] = None,
     ring_chunk_size: Optional[int] = None,
+    ce_chunk: Optional[int] = None,
     **_unused,
 ) -> Workload:
     cfg = config or getattr(GPT2Config, preset)()
@@ -380,6 +436,8 @@ def make_workload(
         cfg = dataclasses.replace(cfg, use_flash_attention=use_flash_attention)
     if ring_chunk_size is not None:
         cfg = dataclasses.replace(cfg, ring_chunk_size=ring_chunk_size)
+    if ce_chunk is not None:
+        cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
         if not cfg.scan_layers:
             raise ValueError(

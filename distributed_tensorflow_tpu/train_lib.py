@@ -333,7 +333,26 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     cluster_lib.assert_same_program("train_state", jax.eval_shape(lambda s: s, state))
 
     # 4. Input pipeline: per-host slice -> global sharded arrays -> prefetch.
-    host_bs = per_host_batch_size(workload.batch_size)
+    # The stream layout comes from the batch sharding's REAL process
+    # partition, not from process_count: on a context/model-parallel-only
+    # mesh the batch dim is replicated, so every host must feed the SAME
+    # full-batch stream (per-process decorrelated halves would assemble an
+    # inconsistent "replicated" array silently).
+    bsh = batch_shardings[workload.example_key]
+    from distributed_tensorflow_tpu.data.pipeline import (
+        host_batch_layout,
+        set_stream_shard_override,
+    )
+
+    host_bs, stream_shards, stream_index = host_batch_layout(
+        bsh, workload.batch_size)
+    if (stream_shards, stream_index) != (jax.process_count(),
+                                         jax.process_index()):
+        logger.info(
+            "batch layout: %d rows/host as stream shard %d/%d (batch dim "
+            "not process-partitioned 1:1)", host_bs, stream_index,
+            stream_shards)
+    set_stream_shard_override(stream_shards, stream_index)
     if args.data_service and args.data_dir:
         raise ValueError("--data_service and --data_dir are mutually "
                          "exclusive (the service owns the record file)")
@@ -342,6 +361,11 @@ def run(args: TrainArgs) -> Dict[str, Any]:
             data_service_data_fn,
         )
 
+        if stream_shards != jax.process_count() and jax.process_count() > 1:
+            raise ValueError(
+                "--data_service splits ONE stream across consumers, which "
+                "cannot express a replicated batch dim (context/model-"
+                "parallel-only mesh); use --data_dir or synthetic input")
         logger.info("out-of-process input service: %s", args.data_service)
         host_iter = data_service_data_fn(args.data_service, workload)(host_bs)
     elif args.data_dir:
@@ -352,10 +376,12 @@ def run(args: TrainArgs) -> Dict[str, Any]:
 
         path = record_path(args.data_dir, args.model)
         logger.info("native record loader: %s", path)
-        host_iter = record_data_fn(path, workload, seed=args.seed)(host_bs)
+        host_iter = record_data_fn(
+            path, workload, seed=args.seed,
+            shard_index=stream_index, shard_count=stream_shards,
+        )(host_bs)
     else:
         host_iter = workload.data_fn(host_bs)
-    bsh = batch_shardings[workload.example_key]
     data_iter = DevicePrefetchIterator(host_iter, bsh, prefetch=2)
 
     # 5. Hooks.
@@ -434,6 +460,7 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         data_iter.close()
         if callable(getattr(host_iter, "close", None)):
             host_iter.close()
+        set_stream_shard_override(None)
         if manager is not None:
             manager.close()
         server.shutdown()
@@ -450,7 +477,10 @@ def make_eval_data(workload, batch_shardings):
     """Eval input stream: the workload's held-out split (eval_data_fn),
     sharded like the train batches.  Falls back to the training stream with
     a warning — eval-on-train cannot measure generalization."""
-    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+    from distributed_tensorflow_tpu.data.pipeline import (
+        host_batch_layout,
+        make_global_batches,
+    )
 
     fn = workload.eval_data_fn
     if fn is None:
@@ -459,8 +489,9 @@ def make_eval_data(workload, batch_shardings):
             "stream", workload.name,
         )
         fn = workload.data_fn
-    host_iter = fn(per_host_batch_size(workload.batch_size))
-    return make_global_batches(host_iter, batch_shardings[workload.example_key])
+    bsh = batch_shardings[workload.example_key]
+    host_bs, _, _ = host_batch_layout(bsh, workload.batch_size)
+    return make_global_batches(fn(host_bs), bsh)
 
 
 def run_evaluator(args: TrainArgs) -> Dict[str, Any]:

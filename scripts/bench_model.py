@@ -38,6 +38,8 @@ def main(argv=None):
                     help="force flash OFF (absent both flags, the "
                          "workload's own default applies, e.g. BERT's "
                          "per-phase auto)")
+    ap.add_argument("--ce_chunk", type=int, default=None,
+                    help="gpt2: chunked cross-entropy length (0 = full)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args(argv)
@@ -56,6 +58,8 @@ def main(argv=None):
     kw = {}
     if args.arch:
         kw["arch"] = args.arch
+    if args.ce_chunk is not None:
+        kw["ce_chunk"] = args.ce_chunk
     wl = get_workload(
         args.model,
         batch_size=args.batch_size * n_dev,

@@ -253,6 +253,36 @@ class TestGPT2:
                 mesh=mesh,
             )
 
+    def test_chunked_ce_matches_full_logits(self):
+        """ce_chunk computes the same loss AND grads as the full (B, T, V)
+        logits path while never materializing it (peak = one (B, chunk, V)
+        tile under a rematerialized scan)."""
+        import dataclasses
+
+        from distributed_tensorflow_tpu.models.gpt2 import (
+            GPT2,
+            GPT2Config,
+            _loss_fn,
+        )
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (4, 128)), jnp.int32)
+        batch = {"tokens": tokens}
+        m_full = GPT2(cfg)
+        m_chunk = GPT2(dataclasses.replace(cfg, ce_chunk=32))
+        params = m_full.init(jax.random.key(0), tokens)["params"]
+        l1, g1 = jax.value_and_grad(
+            lambda p: _loss_fn(m_full, True, p, batch, None)[0])(params)
+        l2, g2 = jax.value_and_grad(
+            lambda p: _loss_fn(m_chunk, True, p, batch, None)[0])(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+            g1, g2,
+        )
+
     def test_dense_oom_config_raises_actionable_error(self):
         """VERDICT r2 weak #3: the flagship config without flash must not
         hit a silent XLA RESOURCE_EXHAUSTED — make_workload refuses it and

@@ -273,6 +273,89 @@ def test_two_process_hybrid_dcn_mesh_training(tmp_path):
         assert f"HYBRID_OK {i}" in out, out[-2000:]
 
 
+RING_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.data.pipeline import (
+    host_batch_layout,
+    make_global_batches,
+    set_stream_shard_override,
+)
+from distributed_tensorflow_tpu.models import get_workload
+from distributed_tensorflow_tpu.models.bert import BertConfig
+from distributed_tensorflow_tpu.train_lib import build_state_and_step
+from distributed_tensorflow_tpu.training import FP32
+
+resolver = cluster_lib.resolve()
+server = cluster_lib.Server.from_resolver(resolver)
+assert jax.process_count() == 2 and jax.device_count() == 8
+
+# context=8 spans BOTH processes: the ring's ppermute crosses the process
+# boundary every step — KV blocks transit the DCN-like hop for real.
+ring_mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=1, context=8))
+owners = [d.process_index for d in ring_mesh.devices.ravel()]
+assert len(set(owners)) == 2, owners
+
+
+def run2(mesh):
+    wl = get_workload("bert", config=BertConfig.tiny(dtype=np.float32),
+                      batch_size=8, seq_len=64, mesh=mesh)
+    state, _, step, batch_sh = build_state_and_step(
+        wl, mesh, precision=FP32, total_steps=4)
+    bsh = batch_sh[wl.example_key]
+    # Feed IDENTICAL global batches to both mesh layouts: every host
+    # generates the full stream (shard override 1/0) and contributes the
+    # rows its devices own per the batch layout (context-only mesh: the
+    # whole replicated batch; data mesh: this process's half).
+    host_bs, n_shards, idx = host_batch_layout(bsh, wl.batch_size)
+    set_stream_shard_override(1, 0)
+    stream = wl.data_fn(wl.batch_size)
+    losses = []
+    rng = jax.random.key(1)
+    for i in range(2):
+        full = next(stream)
+        lo = idx * host_bs
+        batch = {
+            k: jax.make_array_from_process_local_data(
+                bsh, v[lo:lo + host_bs])
+            for k, v in full.items()
+        }
+        state, m = step(state, batch, jax.random.fold_in(rng, i))
+        losses.append(float(m["loss"]))
+    set_stream_shard_override(None)
+    return losses
+
+losses_ring = run2(ring_mesh)
+losses_flat = run2(cluster_lib.build_mesh(cluster_lib.MeshConfig(data=8)))
+# Exact attention: the cross-process ring must train identically to the
+# flat DP mesh (same data, same init).
+np.testing.assert_allclose(losses_ring, losses_flat, rtol=1e-4)
+
+server.shutdown()
+print("RING_MP_OK", jax.process_index(), losses_ring, flush=True)
+os._exit(0)
+"""
+
+
+def test_two_process_ring_attention_context_axis(tmp_path):
+    """Long-context tier-c: BERT's non-causal ring attention with the
+    `context` axis spanning 2 processes — every ppermute KV rotation
+    crosses the process boundary — matches the flat-DP loss exactly."""
+    from tests.helpers import join_workers, spawn_worker_cluster
+
+    procs = spawn_worker_cluster(RING_SCRIPT, 2)
+    outs = join_workers(procs, timeout=420, fail=pytest.fail)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-4000:]}"
+        assert f"RING_MP_OK {i}" in out, out[-2000:]
+
+
 def test_two_process_localhost_cluster(tmp_path):
     import json
 

@@ -99,12 +99,14 @@ def main(argv=None):
     # value bounds the async queue.  A scalar keeps the transfer itself
     # out of the measurement.
     jax.device_get(m["loss"])
+    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
 
     t0 = time.perf_counter()
     for i in range(iters):
         state, m = train_step(state, next(data_iter),
                               jax.random.fold_in(rng, warmup + i))
     jax.device_get(m["loss"])
+    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
     dt = time.perf_counter() - t0
 
     images_per_sec = wl.batch_size * iters / dt

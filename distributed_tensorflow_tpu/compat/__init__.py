@@ -11,6 +11,7 @@ from distributed_tensorflow_tpu.compat.v1 import (
     MonitoredTrainingSession,
     NcclAllReduce,
     ReductionToOneDevice,
+    StopAtStepHook,
     SyncReplicasOptimizer,
     device,
     replica_device_setter,
@@ -22,6 +23,7 @@ __all__ = [
     "MonitoredTrainingSession",
     "NcclAllReduce",
     "ReductionToOneDevice",
+    "StopAtStepHook",
     "SyncReplicasOptimizer",
     "device",
     "replica_device_setter",

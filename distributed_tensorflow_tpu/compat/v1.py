@@ -14,6 +14,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import optax
 
+from distributed_tensorflow_tpu.training.loop import Hook, TrainLoop
+
 logger = logging.getLogger(__name__)
 PyTree = Any
 
@@ -102,8 +104,6 @@ class SyncReplicasOptimizer:
 
     def make_session_run_hook(self, is_chief: bool, num_tokens: int = -1):
         """The original's queue-runner hook is unnecessary (no queues)."""
-        from distributed_tensorflow_tpu.training.loop import Hook
-
         return Hook()
 
 
@@ -178,27 +178,149 @@ class ReductionToOneDevice(CrossDeviceOps):
 
 # -- MonitoredTrainingSession (SURVEY.md §4.2) --------------------------------
 
-def MonitoredTrainingSession(
-    master: str = "",
-    is_chief: bool = True,
-    checkpoint_dir: Optional[str] = None,
-    hooks: Sequence[Any] = (),
-    save_checkpoint_steps: int = 1000,
-    **_unused,
-):
-    """$TF/python/training/monitored_session.py:428 call-shape shim.
+class StopAtStepHook(Hook):
+    """$TF/python/training/basic_session_run_hooks.py StopAtStepHook shim.
 
-    Returns a factory mapping onto ``training.TrainLoop``: there is no
-    session to run ops in, so the shim returns the pieces the TF1 pattern
-    supplied implicitly — a CheckpointManager rooted at ``checkpoint_dir``
-    (created only on the chief, mirroring the original's chief-only saving)
-    and the hook list to extend.  See train_lib.run for the full loop.
+    The TF1 way to bound the ``while not sess.should_stop()`` loop: request
+    stop once the global step reaches ``last_step`` (absolute) or has
+    advanced ``num_steps`` past where the session started (relative —
+    resume-aware, like the original).
     """
-    manager = None
-    if checkpoint_dir and is_chief:
-        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
 
-        manager = CheckpointManager(
-            checkpoint_dir, save_interval_steps=save_checkpoint_steps
+    def __init__(self, num_steps: Optional[int] = None,
+                 last_step: Optional[int] = None):
+        if (num_steps is None) == (last_step is None):
+            raise ValueError("exactly one of num_steps/last_step required")
+        self._num_steps = num_steps
+        self._last_step = last_step
+
+    def begin(self, loop) -> None:
+        if self._last_step is None:
+            start = int(jax.device_get(loop.state.step))
+            self._last_step = start + self._num_steps
+
+    def after_step(self, loop, step: int, metrics) -> None:
+        if step >= self._last_step:
+            loop.request_stop()
+
+
+class MonitoredTrainingSession(TrainLoop):
+    """$TF/python/training/monitored_session.py:428 — a REAL session object.
+
+    The reference's hot-loop idiom runs verbatim::
+
+        with MonitoredTrainingSession(master=server.target, is_chief=is_chief,
+                                      checkpoint_dir=ckpt_dir,
+                                      hooks=[StopAtStepHook(last_step=N)],
+                                      state=state, data_iter=data_iter) as sess:
+            while not sess.should_stop():
+                sess.run(train_op)
+
+    What maps where:
+
+    - The TF1 session owned the variables and restored the latest checkpoint
+      on creation; here the sharded ``TrainState`` plays that role — passed
+      at construction (there is no default graph to pull it from) and
+      restored via ``CheckpointManager.restore_or_init`` on ``__enter__``.
+    - ``train_op`` is the compiled train step (``build_state_and_step``'s
+      ``(state, batch, rng) -> (state, metrics)``) — in TF1 the op closed
+      over the input pipeline; here the session owns ``data_iter`` and feeds
+      one batch per ``run``.
+    - Chief-only checkpoint *files*: TF1 gated the saver hook on
+      ``is_chief``; orbax's multi-process contract is that every process
+      participates in save/restore while only the primary host writes
+      metadata — so the manager is created on every process (matching
+      ``train_lib.run``) and ``is_chief`` is honored at the file level by
+      orbax itself.
+    - Hooks are ``training.loop.Hook``s (the SessionRunHook equivalent);
+      all of Logging/Nan/Checkpoint/Profiler/Eval work unchanged, plus
+      ``StopAtStepHook`` above for loop bounding.
+    """
+
+    def __init__(
+        self,
+        master: str = "",
+        is_chief: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        hooks: Sequence[Any] = (),
+        chief_only_hooks: Sequence[Any] = (),
+        save_checkpoint_steps: int = 1000,
+        *,
+        state=None,
+        data_iter=(),
+        rng=None,
+        metrics_every: int = 10,
+        examples_per_step: int = 0,
+        **_unused,
+    ):
+        if state is None:
+            raise ValueError(
+                "MonitoredTrainingSession needs the TrainState: TF1 pulled "
+                "variables from the default graph; pass state= (from "
+                "build_state_and_step)"
+            )
+        session_hooks = list(hooks)
+        if is_chief:
+            session_hooks.extend(chief_only_hooks)
+        self._manager = None
+        if checkpoint_dir:
+            from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+            from distributed_tensorflow_tpu.training.loop import CheckpointHook
+
+            self._manager = CheckpointManager(
+                checkpoint_dir, save_interval_steps=save_checkpoint_steps
+            )
+            session_hooks.append(
+                CheckpointHook(self._manager,
+                               every_steps=save_checkpoint_steps)
+            )
+        super().__init__(
+            train_step=None,  # the op arrives per sess.run(train_op)
+            state=state,
+            data_iter=data_iter,
+            hooks=session_hooks,
+            examples_per_step=examples_per_step,
+            metrics_every=metrics_every,
+            rng=rng,
         )
-    return manager, list(hooks)
+        self.master = master
+        self.is_chief = is_chief
+        self._closed = False
+        self._step = 0
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def __enter__(self) -> "MonitoredTrainingSession":
+        if self._manager is not None:
+            self.state = self._manager.restore_or_init(self.state)
+        self._step = int(jax.device_get(self.state.step))
+        for h in self.hooks:
+            h.begin(self)
+        return self
+
+    def run(self, train_op, *_unused_fetches):
+        """One ``sess.run(train_op)``: feed a batch, run the compiled step.
+
+        Returns the host metrics dict on ``metrics_every`` boundaries (None
+        otherwise — other steps stay fully async on device, the same
+        throttling as ``TrainLoop``, whose ``run_one_step`` this drives).
+        """
+        if self._stop:
+            raise RuntimeError(
+                "run() called after should_stop() requested stop"
+            )
+        self._step = self.run_one_step(self._step, train_step=train_op)
+        return self.last_step_metrics
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for h in self.hooks:
+            h.end(self, self._step)
+        if self._manager is not None:
+            self._manager.close()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -62,7 +62,8 @@ class BertConfig:
     # nn.scan unroll factor (see GPT2Config.scan_unroll: amortizes the
     # stacked-grad dynamic-update-slice writes across unrolled layers).
     scan_unroll: int = 1
-    # Pallas fused attention (non-causal); drops attention-prob dropout.
+    # Pallas fused attention (non-causal); attention-prob dropout runs
+    # in-kernel (TPU PRNG), so the recipe matches dense.
     # Default is per-phase, set by make_workload from measurement (v5e,
     # 2026-07-30, masked batches): dense wins at seq 128 (867 vs 781
     # seq/s/chip — the (T,T) tile is small enough that XLA's fused dense
@@ -114,8 +115,14 @@ class EncoderLayer(nn.Module):
                 kv_mask=input_mask,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
+            # Attention-prob dropout runs IN-KERNEL (TPU PRNG, identical
+            # keep mask regenerated in backward) — the flash path no longer
+            # changes the training recipe vs dense.
+            drop = 0.0 if deterministic else cfg.dropout
             ctx = flash_attention(
-                q, k, v, causal=False, kv_mask=input_mask
+                q, k, v, causal=False, kv_mask=input_mask,
+                dropout_rate=drop,
+                dropout_rng=self.make_rng("dropout") if drop > 0 else None,
             ).reshape(B, T, d)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)

@@ -66,8 +66,8 @@ class GPT2Config:
     # more FLOPs for activation memory ~ O(sqrt) — the TPU-native answer to
     # the reference's gradient-accumulation-for-memory config.
     remat: bool = True
-    # Pallas fused attention (ops.flash_attention).  Disables attention-prob
-    # dropout (the prob matrix never materializes); residual dropout stays.
+    # Pallas fused attention (ops.flash_attention).  Attention-prob dropout
+    # runs in-kernel (TPU PRNG), matching the dense path's recipe.
     use_flash_attention: bool = False
     # GPipe microbatches when the mesh's ``pipe`` axis > 1 (0 = auto: the
     # largest of {4S, 2S, S} dividing the batch).  Bubble fraction is
@@ -129,7 +129,14 @@ class Block(nn.Module):
                 chunk_size=cfg.ring_chunk_size or None,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
-            ctx = flash_attention(q, k, v, causal=True).reshape(B, T, d)
+            # Attention-prob dropout runs IN-KERNEL (TPU PRNG, identical
+            # keep mask regenerated in backward) — the flash path keeps the
+            # dense path's training recipe.
+            drop = 0.0 if deterministic else cfg.dropout
+            ctx = flash_attention(
+                q, k, v, causal=True, dropout_rate=drop,
+                dropout_rng=self.make_rng("dropout") if drop > 0 else None,
+            ).reshape(B, T, d)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
             mask = jnp.tril(jnp.ones((T, T), bool))
@@ -392,12 +399,18 @@ def _guard_dense_attention_memory(cfg, *, seq, batch_size, grad_accum_steps,
     if os.environ.get("DTT_SKIP_DENSE_ATTN_GUARD", "") == "1":
         return
     micro = max(1, batch_size // (dp * max(1, grad_accum_steps)))
+    # Attention heads shard over the tensor axis (column-parallel qkv), so
+    # the per-chip score buffer carries H / tensor heads (ADVICE r3: a
+    # valid TP config must not be falsely rejected).
+    heads = cfg.n_head
+    if mesh is not None:
+        heads = max(1, heads // mesh.shape.get("tensor", 1))
     # ~6 live (micro, H, T, T) buffers around the softmax in the remat
     # backward (f32 scores + probs forward-recomputed, their cotangents,
     # bf16 probs both ways); calibrated to the measured boundary: medium/
     # seq-1024 OOMs at microbatch 16 (6.4 GiB by this model) and fits at
     # microbatch 4 (1.6 GiB) on a 16 GiB v5e.
-    approx_bytes = 6 * micro * cfg.n_head * seq * seq * 4
+    approx_bytes = 6 * micro * heads * seq * seq * 4
     # Budget = 1/4 of device memory (the rest is params/acts/grads).
     # Bigger-HBM chips (v4/v5p) get a proportionally higher ceiling;
     # platforms that don't report memory use the 16 GiB v5e assumption.

@@ -168,11 +168,17 @@ def criteo_tables(
     for sparse features (TPUEmbedding's per-table optimizer role,
     tpu_embedding_v2_utils.py:1319) — while the rest use the model default.
     """
+    # combiner pinned explicitly (ADVICE r3): the TableConfig default
+    # follows TPUEmbedding's "mean"; these slots are single-valent (one id
+    # per slot), where sum == mean, but pinning keeps the pooling semantics
+    # independent of the default.
     tables = [
         TableConfig(vocab_sizes[0], emb_dim, name="table_large",
-                    optimizer=optax.adagrad(embedding_lr)),
-        TableConfig(vocab_sizes[1], emb_dim, name="table_medium"),
-        TableConfig(vocab_sizes[2], emb_dim, name="table_small"),
+                    combiner="sum", optimizer=optax.adagrad(embedding_lr)),
+        TableConfig(vocab_sizes[1], emb_dim, name="table_medium",
+                    combiner="sum"),
+        TableConfig(vocab_sizes[2], emb_dim, name="table_small",
+                    combiner="sum"),
     ]
     return tuple(
         FeatureConfig(table=tables[i % len(tables)], name=f"slot_{i}")

@@ -6,38 +6,57 @@ VMEM with an online softmax, so HBM traffic is O(T·D) and the score tile
 lives entirely on-chip feeding the MXU.  (The reference's equivalent layer is
 fused CUDA attention inside TF's binary — SURVEY.md §2 L0.)
 
-Design:
+Design (round-4 schedule — FlashAttention-2 style grid streaming):
 
-- Forward grid: (batch·heads, T/BLOCK_Q).  Each program owns one query block
-  and loops over key blocks in VMEM; running max / denominator / accumulator
-  are f32 VMEM values.  When taken under ``jax.vjp`` the kernel also writes
-  the per-row logsumexp (LSE = m + log l) for the backward pass,
-  lane-broadcast to (…, T, 128) because Mosaic requires last-two-dims tiles
-  of (8, 128) (same layout as jax.experimental.pallas.ops.tpu.flash_attention).
-- Causal masking is positional inside the tile; with ``causal=True`` key
-  blocks entirely above the diagonal are skipped by loop bound, not masked —
-  ~2x fewer tiles for long sequences.
+- All three kernels run a 3-D grid ``(batch·heads, outer block, inner
+  block)`` where the INNER grid dimension streams the loop operand through
+  VMEM in blocks — no kernel keeps a full-T window resident.  That is what
+  lifts the old T≤6144 cap: the previous backward kept (T, D) q/o/g and a
+  (T, 128) lse window per program, which exceeded VMEM at T=8192·H=16
+  (measured: "scoped allocation 16.50M > 16.00M" on v5e).  Per-row running
+  statistics (m, l) and the f32 accumulators live in VMEM scratch, which on
+  TPU persists across sequential grid steps; they are initialized when the
+  inner index is 0 and finalized on its last value.
+- Head layout: q/k/v arrive as (B, T, H, D) and are transposed to
+  (B·H, T, D) for the kernels.  A transpose-free layout (viewing
+  (B, T, H·D) and selecting each head's D-slice via BlockSpec index maps)
+  was attempted this round and is impossible under Mosaic's tiling rule —
+  the last block dim must be 128-divisible or equal to the full array dim,
+  and a D=64 lane slice is neither (lowering rejects it).  See
+  ``_to_heads`` for the measurement note.
+- Forward: inner dim streams key blocks.  Causal masking is positional
+  inside the tile; key blocks entirely above the diagonal skip their
+  compute via ``pl.when`` (their DMAs still run — the schedule trade for
+  streaming).
 - Key padding masks (``kv_mask``, the reference stack's per-op
   ``attention_mask`` input derived from BERT's ``input_mask``): a (B, Tk)
-  validity row is loaded per program — batch index = program // heads — and
-  each key block's slice zeroes masked keys' probabilities via s = -inf.
-  Only KEYS are masked (TF semantics: the mask broadcasts over queries);
-  padded queries produce garbage rows that the loss never consumes.
-- Backward (FlashAttention-2 schedule, no atomics): two kernels.
-  * dQ: grid over query blocks; loops over key blocks, recomputing
-    P = exp(S − LSE) per tile from the stored LSE (no (T,T) buffer).
-  * dK/dV: grid over key blocks; loops over query blocks.  Each program
-    accumulates its own dk/dv tile, so no cross-program reduction is needed.
-  Both compute Δ = rowsum(dO ∘ O) in-kernel from the saved output (cheap
-  elementwise on tiles already resident in VMEM) and use
+  validity row, blocked to the key tile; masked keys' probabilities are
+  zeroed via s = -inf.  Only KEYS are masked (TF semantics).
+- Backward (no atomics): two kernels.
+  * dQ: inner dim streams key blocks; recomputes P = exp(S − LSE) per tile
+    from the stored LSE (no (T,T) buffer anywhere).
+  * dK/dV: inner dim streams QUERY blocks (q/o/g/lse arrive (block_q, ·)
+    at a time); each program owns one key block's dk/dv tile.
+  Both compute Δ = rowsum(dO ∘ O) from the saved output per q tile and use
   dS = P ∘ (dP − Δ) · scale.
+- Attention-probability dropout (the reference models' training recipe —
+  TF's fused attention keeps it; round 3 silently dropped it on the flash
+  path): implemented IN-KERNEL with the TPU PRNG
+  (``pltpu.prng_seed``/``prng_random_bits``), seeded per
+  (batch·head, q-block, k-block) tile so forward and both backward kernels
+  regenerate the identical keep mask.  Dropout follows softmax semantics:
+  the denominator l accumulates UN-dropped probabilities; only the P·V
+  (and matching dV/dP backward) contractions see the dropped, 1/(1-rate)
+  rescaled probabilities.
 - ``flash_attention_with_lse`` returns (out, lse) and is differentiable in
   BOTH outputs: ∂lse/∂s = P, so the lse cotangent folds into the backward
   kernels as dS = P ∘ (dP − Δ + g_lse) · scale.  This is the building block
-  ring attention consumes per key block (the per-block lse drives the exact
-  cross-block online-softmax combine).
+  ring attention consumes per key block.  (No dropout on this path: the
+  ring's cross-block combine assumes exact per-block softmax statistics.)
 - Non-TPU platforms and awkward shapes fall back to the dense XLA path with
-  identical numerics (f32 softmax); its backward is XLA autodiff.
+  identical numerics (f32 softmax); its backward is XLA autodiff.  The
+  fallback's dropout uses ``jax.random`` — same distribution, different
+  mask realization than the kernel PRNG (documented, tested for moments).
 """
 
 from __future__ import annotations
@@ -82,7 +101,13 @@ def _interpret() -> bool:
     return os.environ.get("DTT_PALLAS_INTERPRET", "") == "1"
 
 
-def _dense(q, k, v, *, causal, scale, kv_mask=None):
+def _dropout_mask(rng, shape, rate):
+    keep = jax.random.bernoulli(rng, 1.0 - rate, shape)
+    return keep.astype(jnp.float32) / (1.0 - rate)
+
+
+def _dense(q, k, v, *, causal, scale, kv_mask=None, dropout_rate=0.0,
+           dropout_rng=None):
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T = q.shape[1]
@@ -93,6 +118,8 @@ def _dense(q, k, v, *, causal, scale, kv_mask=None):
             (kv_mask > 0)[:, None, None, :], scores, -jnp.inf
         )
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        probs = probs * _dropout_mask(dropout_rng, probs.shape, dropout_rate)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
@@ -119,25 +146,143 @@ def _dense_with_lse(q, k, v, *, causal, scale, kv_mask=None):
     return out, lse
 
 
-def _kernel(q_ref, k_ref, v_ref, *rest, seq_len, causal, scale,
-            block_q, block_k, save_lse, has_mask):
+def _tile_dropout(seed_ref, b, qi, kj, shape, rate):
+    """Regenerate the identical keep/rescale mask for tile (b, qi, kj) in
+    any kernel: seed the per-core PRNG with the tile coordinates.  Mosaic
+    accepts at most two seed values, so b rides the first (added to the
+    user seed — injective over the full int32 program range) and (qi, kj)
+    pack into the second (qi/kj < 2^16 blocks, i.e. T < 8.4M — far beyond
+    any VMEM-feasible grid)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed_ref[0] + b, (qi << 16) | kj)
+    bits = pltpu.prng_random_bits(shape)  # int32, uniform over 2^32
+    # P(keep) = 1 - rate via unsigned threshold compare.
+    thresh = np.int32(
+        np.uint32(np.round(rate * 2.0**32) - 2**31)
+    )  # shift to signed domain
+    keep = bits >= thresh
+    return jnp.where(keep, 1.0 / (1.0 - rate), 0.0)
+
+
+def _causal_tile_mask(s, qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+
+def _fwd_kernel(*refs, causal, scale, block_q, block_k, save_lse,
+                has_mask, dropout_rate):
     from jax.experimental import pallas as pl
 
-    rest = list(rest)
-    mask_ref = rest.pop(0) if has_mask else None
-    o_ref = rest.pop(0)
-    lse_ref = rest.pop(0) if save_lse else None
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    mask_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    o_ref = refs.pop(0)
+    lse_ref = refs.pop(0) if save_lse else None
+    acc_ref, m_ref, l_ref = refs[-3:]
+
+    b = pl.program_id(0)
     qi = pl.program_id(1)
-    # Keep matmul operands in the input dtype (bf16 in production): the MXU
-    # runs bf16 x bf16 -> f32 at full rate, f32 x f32 at a fraction of it.
-    # All accumulation/softmax statistics stay f32 (preferred_element_type).
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Key blocks entirely above the causal diagonal contribute nothing.
+    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        # Keep matmul operands in the input dtype (bf16 in production): the
+        # MXU runs bf16 x bf16 -> f32 at full rate.  All accumulation /
+        # softmax statistics stay f32 (preferred_element_type).
+        q = q_ref[0]  # (block_q, D)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k) f32
+        if causal:
+            s = _causal_tile_mask(s, qi, kj, block_q, block_k)
+        if has_mask:
+            s = jnp.where(mask_ref[0] > 0, s, -jnp.inf)  # (1, block_k)
+        m_prev = m_ref[...][:, :1]  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
+                                  -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+        l_prev = l_ref[...][:, :1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # Softmax-dropout semantics: l sees UN-dropped p; only the PV
+        # contraction sees the dropped/rescaled probabilities.
+        if dropout_rate > 0.0:
+            p = p * _tile_dropout(seed_ref, b, qi, kj,
+                                  (block_q, block_k), dropout_rate)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_safe, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        if save_lse:
+            # Rows with zero valid keys (l == 0) get lse = -1e30, so a
+            # downstream exp(lse - anything) underflows to an exact no-op
+            # contribution (ring attention's cross-block combine).
+            m = m_ref[...][:, :1]
+            lse = jnp.where(l > 0, m + jnp.log(l_safe), -1e30)
+            lse_ref[0] = jnp.broadcast_to(lse, (block_q, LANES))
+
+
+# ---------------------------------------------------------------------------
+# Resident-schedule kernels: the whole loop operand (K/V for fwd+dQ, nothing
+# extra for dK/dV, which streams) stays in VMEM and the kernel iterates it
+# with an in-register fori_loop.  Measured faster than the streaming grid at
+# production T (31.0k vs 28.5k GPT-2 tok/s at T=1024, v5e, this round):
+# loop carries live in vector registers instead of scratch round-trips and
+# there is no per-block grid prologue.  Chosen by `_resident_*_bytes` when
+# the windows fit; the streaming kernels above are the long-T schedule.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_resident(*refs, seq_len, causal, scale, block_q, block_k,
+                         save_lse, has_mask, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    mask_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    o_ref = refs.pop(0)
+    lse_ref = refs.pop(0) if save_lse else None
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
     q = q_ref[0]  # (block_q, D)
     D = q.shape[-1]
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
-        # highest key block that intersects the causal triangle of this
-        # q block: floor(((qi+1)*block_q - 1) / block_k) + 1
+        # highest key block intersecting this q block's causal triangle
         hi = ((qi + 1) * block_q - 1) // block_k + 1
         hi = jnp.minimum(hi, num_k_blocks)
     else:
@@ -152,15 +297,9 @@ def _kernel(q_ref, k_ref, v_ref, *rest, seq_len, causal, scale,
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k) f32
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = _causal_tile_mask(s, qi, j, block_q, block_k)
         if has_mask:
-            m_blk = mask_ref[0, :, pl.ds(j * block_k, block_k)]  # (1, block_k)
+            m_blk = mask_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(m_blk > 0, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -168,8 +307,9 @@ def _kernel(q_ref, k_ref, v_ref, *rest, seq_len, causal, scale,
         alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
         alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # p in the v dtype for the MXU (same cast the dense path applies
-        # to probs before its PV einsum); accumulator stays f32.
+        if dropout_rate > 0.0:
+            p = p * _tile_dropout(seed_ref, b, qi, j,
+                                  (block_q, block_k), dropout_rate)
         acc = acc * alpha + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -183,84 +323,21 @@ def _kernel(q_ref, k_ref, v_ref, *rest, seq_len, causal, scale,
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     if save_lse:
-        # Rows with zero valid keys (l == 0) get lse = -1e30, so a
-        # downstream exp(lse - anything) underflows to an exact no-op
-        # contribution (ring attention's cross-block combine relies on it).
         lse = jnp.where(l > 0, m + jnp.log(l_safe), -1e30)
         lse_ref[0] = jnp.broadcast_to(lse, (block_q, LANES))
 
 
-def _to_heads(x):
-    B, T, H, D = x.shape
-    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-
-def _from_heads(x, B, H):
-    BH, T, D = x.shape
-    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
-
-
-def _flash_fwd_tpu(q, k, v, kv_mask, *, causal, scale, save_lse):
-    """Returns out (B,T,H,D), and lse (B·H, T, LANES) f32 if save_lse."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    B, T, H, D = q.shape
-    block_q = _fit_block(T, BLOCK_Q)
-    block_k = _fit_block(T, BLOCK_K)
-    has_mask = kv_mask is not None
-    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
-    grid = (B * H, pl.cdiv(T, block_q))
-    in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-    ]
-    operands = [qh, kh, vh]
-    if has_mask:
-        # One (1, 1, Tk) validity row per program; batch index = program
-        # // H.  The leading singleton keeps the block's last two dims
-        # equal to the array dims (Mosaic's tiling requirement — a (1, Tk)
-        # 2D block has an un-tileable sublane dim of 1).
-        in_specs.append(
-            pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0)))
-        operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
-    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
-    if save_lse:
-        out_specs.append(
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32))
-    res = pl.pallas_call(
-        functools.partial(
-            _kernel, seq_len=T, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k, save_lse=save_lse,
-            has_mask=has_mask,
-        ),
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
-    )(*operands)
-    if save_lse:
-        return _from_heads(res[0], B, H), res[1]
-    return _from_heads(res[0], B, H), None
-
-
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
-                   seq_len, causal, scale, block_q, block_k,
-                   has_mask, has_glse):
+def _dq_kernel_resident(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
+                        seq_len, causal, scale, block_q, block_k,
+                        has_mask, has_glse, dropout_rate):
     from jax.experimental import pallas as pl
 
     rest = list(rest)
     glse_ref = rest.pop(0) if has_glse else None
     mask_ref = rest.pop(0) if has_mask else None
+    seed_ref = rest.pop(0) if dropout_rate > 0.0 else None
     dq_ref = rest.pop(0)
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0]                              # (block_q, D), input dtype
     g = g_ref[0]                              # (block_q, D)
@@ -271,7 +348,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
         axis=-1, keepdims=True,
     )
     if has_glse:
-        # dS gains + g_lse ∘ P (∂lse/∂s = P): fold into the Δ subtraction.
         delta = delta - glse_ref[0][:, :1]
     D = q.shape[-1]
 
@@ -290,13 +366,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = _causal_tile_mask(s, qi, j, block_q, block_k)
         if has_mask:
             m_blk = mask_ref[0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(m_blk > 0, s, -jnp.inf)
@@ -304,7 +374,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
         dp = jax.lax.dot_general(
             g, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                     # (block_q, block_k)
+        )
+        if dropout_rate > 0.0:
+            dp = dp * _tile_dropout(seed_ref, b, qi, j,
+                                    (block_q, block_k), dropout_rate)
         ds = p * (dp - delta) * scale
         return dq_acc + jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
@@ -315,15 +388,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
     dq_ref[0] = jax.lax.fori_loop(0, hi, body, dq0).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
-                    seq_len, causal, scale, block_q, block_k,
-                    has_mask, has_glse):
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
+                         seq_len, causal, scale, block_q, block_k,
+                         has_mask, has_glse, dropout_rate):
     from jax.experimental import pallas as pl
 
     rest = list(rest)
     glse_ref = rest.pop(0) if has_glse else None
     mask_ref = rest.pop(0) if has_mask else None
+    seed_ref = rest.pop(0) if dropout_rate > 0.0 else None
     dk_ref, dv_ref = rest
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     k = k_ref[0]                              # (block_k, D), input dtype
     v = v_ref[0]                              # (block_k, D)
@@ -331,12 +406,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
     if causal:
-        # lowest query block that intersects this key block's causal wedge
         lo = (ki * block_k) // block_q
     else:
         lo = 0
     if has_mask:
-        my_mask = mask_ref[0, :, pl.ds(ki * block_k, block_k)]  # (1, block_k)
+        my_mask = mask_ref[0, :, pl.ds(ki * block_k, block_k)]
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -355,25 +429,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
             preferred_element_type=jnp.float32,
         ) * scale                             # (block_q, block_k)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = _causal_tile_mask(s, i, ki, block_q, block_k)
         if has_mask:
             s = jnp.where(my_mask > 0, s, -jnp.inf)
         p = jnp.exp(s - lse)
-        # dV += P^T dO
+        if dropout_rate > 0.0:
+            drop = _tile_dropout(seed_ref, b, i, ki,
+                                 (block_q, block_k), dropout_rate)
+            p_v = p * drop
+        else:
+            p_v = p
+        # dV += (P∘M)^T dO
         dv_acc = dv_acc + jax.lax.dot_general(
-            p.astype(g_blk.dtype), g_blk, (((0,), (0,)), ((), ())),
+            p_v.astype(g_blk.dtype), g_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             g_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            dp = dp * drop
         ds = p * (dp - delta) * scale
         # dK += dS^T Q
         dk_acc = dk_acc + jax.lax.dot_general(
@@ -388,7 +464,287 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, *rest,
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, g_lse, *, causal, scale):
+# VMEM budget for keeping a kernel's loop windows resident (the windows are
+# double-buffered by the pipeline, hence the 2x in the estimates).  16 MB
+# VMEM on v5e.  Measured boundary: the dkv windows at T=8192, D=64 (q/o/g
+# 3 MB + lse 4 MB, x2 = 14 MB estimate) abort Mosaic ("scoped allocation
+# 16.50M > 16.00M"), while the ring path's T=4096+g_lse case (11.5 MB
+# estimate) compiles and is +65% over einsum — so the cutoff sits between:
+# 13 MB keeps every shape that compiles on the fast resident schedule.
+RESIDENT_VMEM_BUDGET = int(
+    os.environ.get("DTT_FLASH_RESIDENT_BUDGET", str(13 * 2**20)))
+
+
+def _resident_kv_bytes(T, D, itemsize):
+    return 2 * (2 * T * D * itemsize)  # K + V windows, double-buffered
+
+
+def _resident_dkv_bytes(T, D, itemsize, has_glse):
+    win = 3 * T * D * itemsize + T * LANES * 4 * (2 if has_glse else 1)
+    return 2 * win  # q/o/g + lse (+ g_lse) windows, double-buffered
+
+
+def _to_heads(x):
+    """(B, T, H, D) -> (B·H, T, D).
+
+    A transpose-free layout (viewing (B, T, H·D) and selecting the head's
+    D-slice in the BlockSpec index map) was attempted and is IMPOSSIBLE
+    under Mosaic's tiling rule: the last block dim must be 128-divisible or
+    equal to the array dim, and a per-head D=64 lane slice is neither
+    (measured this round: lowering rejects block (1, bq, 64) on array
+    (B, T, 1024)).  The transpose is therefore structural for D=64 heads.
+    """
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_heads(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _seed_operand(dropout_rng):
+    """Fold a JAX PRNG key to the int32 scalar the kernel PRNG consumes."""
+    bits = jax.random.bits(dropout_rng, dtype=jnp.uint32)
+    return bits.astype(jnp.int32).reshape(1)
+
+
+def _flash_fwd_tpu(q, k, v, kv_mask, *, causal, scale, save_lse,
+                   dropout_rate=0.0, seed=None):
+    """Returns out (B,T,H,D), and lse (B·H, T, LANES) f32 if save_lse."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    block_q = _fit_block(T, BLOCK_Q)
+    block_k = _fit_block(T, BLOCK_K)
+    has_mask = kv_mask is not None
+    has_dropout = dropout_rate > 0.0
+    nq, nk = pl.cdiv(T, block_q), pl.cdiv(T, block_k)
+    resident = (_resident_kv_bytes(T, D, q.dtype.itemsize)
+                <= RESIDENT_VMEM_BUDGET)
+
+    operands = [_to_heads(q), _to_heads(k), _to_heads(v)]
+    if resident:
+        grid = (B * H, nq)
+        qmap = lambda b, i: (b, i, 0)
+        in_specs = [
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),  # K resident
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),  # V resident
+        ]
+        mask_spec = pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0))
+        lse_spec = pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0))
+        kernel = functools.partial(
+            _fwd_kernel_resident, seq_len=T, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, save_lse=save_lse,
+            has_mask=has_mask, dropout_rate=dropout_rate,
+        )
+        scratch = []
+        semantics = ("parallel", "arbitrary")
+    else:
+        grid = (B * H, nq, nk)
+        qmap = lambda b, i, j: (b, i, 0)
+        kmap = lambda b, i, j: (b, j, 0)
+        in_specs = [
+            pl.BlockSpec((1, block_q, D), qmap),
+            pl.BlockSpec((1, block_k, D), kmap),
+            pl.BlockSpec((1, block_k, D), kmap),
+        ]
+        mask_spec = pl.BlockSpec((1, 1, block_k),
+                                 lambda b, i, j: (b // H, 0, j))
+        lse_spec = pl.BlockSpec((1, block_q, LANES),
+                                lambda b, i, j: (b, i, 0))
+        kernel = functools.partial(
+            _fwd_kernel, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, save_lse=save_lse,
+            has_mask=has_mask, dropout_rate=dropout_rate,
+        )
+        scratch = [
+            pltpu.VMEM((block_q, D), jnp.float32),      # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running denom
+        ]
+        semantics = ("parallel", "parallel", "arbitrary")
+    if has_mask:
+        # The leading singleton keeps the block's sublane dim tileable (a
+        # 2-D (1, Tk) block would have an un-tileable sublane dim of 1).
+        in_specs.append(mask_spec)
+        operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
+    if has_dropout:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed)
+    out_specs = [pl.BlockSpec((1, block_q, D), qmap)]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
+    if save_lse:
+        out_specs.append(lse_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32))
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=semantics,
+        ),
+        interpret=_interpret(),
+    )(*operands)
+    out = _from_heads(res[0], B, H)
+    if save_lse:
+        return out, res[1]
+    return out, None
+
+
+def _bwd_dq_kernel(*refs, causal, scale, block_q, block_k,
+                   has_mask, has_glse, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    refs = list(refs)
+    q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref = refs[:6]
+    refs = refs[6:]
+    glse_ref = refs.pop(0) if has_glse else None
+    mask_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    dq_ref = refs.pop(0)
+    dq_acc_ref = refs[-1]
+
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                          # (block_q, D), input dtype
+        g = g_ref[0]                          # (block_q, D)
+        o = o_ref[0]                          # (block_q, D)
+        lse = lse_ref[0][:, :1]               # (block_q, 1)
+        delta = jnp.sum(                      # Δ = rowsum(dO ∘ O), f32
+            g.astype(jnp.float32) * o.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+        if has_glse:
+            # dS gains + g_lse ∘ P (∂lse/∂s = P): fold into Δ subtraction.
+            delta = delta - glse_ref[0][:, :1]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_tile_mask(s, qi, kj, block_q, block_k)
+        if has_mask:
+            s = jnp.where(mask_ref[0] > 0, s, -jnp.inf)
+        p = jnp.exp(s - lse)                  # masked -> exp(-inf) = 0
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                     # (block_q, block_k)
+        if dropout_rate > 0.0:
+            dp = dp * _tile_dropout(seed_ref, b, qi, kj,
+                                    (block_q, block_k), dropout_rate)
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, causal, scale, block_q, block_k,
+                    has_mask, has_glse, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    refs = list(refs)
+    q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref = refs[:6]
+    refs = refs[6:]
+    glse_ref = refs.pop(0) if has_glse else None
+    mask_ref = refs.pop(0) if has_mask else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    dk_ref, dv_ref = refs[0], refs[1]
+    dk_acc_ref, dv_acc_ref = refs[-2:]
+
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # Query blocks entirely above this key block's causal wedge skip.
+    run = ((qi + 1) * block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[0]                          # (block_k, D), input dtype
+        v = v_ref[0]                          # (block_k, D)
+        q_blk = q_ref[0]                      # (block_q, D)
+        g_blk = g_ref[0]
+        o_blk = o_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = jnp.sum(
+            g_blk.astype(jnp.float32) * o_blk.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+        if has_glse:
+            delta = delta - glse_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                             # (block_q, block_k)
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k)
+        if has_mask:
+            s = jnp.where(mask_ref[0] > 0, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        if dropout_rate > 0.0:
+            drop = _tile_dropout(seed_ref, b, qi, ki,
+                                 (block_q, block_k), dropout_rate)
+            p_v = p * drop                    # what the PV contraction saw
+        else:
+            p_v = p
+        # dV += (P∘M)^T dO
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p_v.astype(g_blk.dtype), g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            dp = dp * drop
+        ds = p * (dp - delta) * scale
+        # dK += dS^T Q
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, g_lse, *, causal, scale,
+                   dropout_rate=0.0, seed=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -397,73 +753,158 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, g_lse, *, causal, scale):
     block_k = _fit_block(T, BLOCK_K)
     has_mask = kv_mask is not None
     has_glse = g_lse is not None
+    has_dropout = dropout_rate > 0.0
+    nq, nk = pl.cdiv(T, block_q), pl.cdiv(T, block_k)
     qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
     gh, oh = _to_heads(g), _to_heads(o)
+    mask_op = (kv_mask.astype(jnp.int32).reshape(B, 1, T)
+               if has_mask else None)
 
-    common = dict(seq_len=T, causal=causal, scale=scale,
+    common = dict(causal=causal, scale=scale,
                   block_q=block_q, block_k=block_k,
-                  has_mask=has_mask, has_glse=has_glse)
+                  has_mask=has_mask, has_glse=has_glse,
+                  dropout_rate=dropout_rate)
+    itemsize = q.dtype.itemsize
+    dq_resident = _resident_kv_bytes(T, D, itemsize) <= RESIDENT_VMEM_BUDGET
+    dkv_resident = (_resident_dkv_bytes(T, D, itemsize, has_glse)
+                    <= RESIDENT_VMEM_BUDGET)
 
-    dq_in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # q
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # k
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),         # v
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # o
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),   # g
-        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-    ]
+    # dQ: resident = K/V windows stay in VMEM, fori_loop over key blocks;
+    # streaming = grid (B·H, q block, streamed k block).
+    if dq_resident:
+        qmap = lambda b, i: (b, i, 0)
+        full = lambda b, i: (b, 0, 0)
+        dq_in_specs = [
+            pl.BlockSpec((1, block_q, D), qmap),             # q
+            pl.BlockSpec((1, T, D), full),                   # k (resident)
+            pl.BlockSpec((1, T, D), full),                   # v (resident)
+            pl.BlockSpec((1, block_q, D), qmap),             # o
+            pl.BlockSpec((1, block_q, D), qmap),             # g
+            pl.BlockSpec((1, block_q, LANES), qmap),         # lse
+        ]
+        dq_glse_spec = pl.BlockSpec((1, block_q, LANES), qmap)
+        dq_mask_spec = pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0))
+        dq_kernel = functools.partial(_dq_kernel_resident, seq_len=T,
+                                      **common)
+        dq_grid = (B * H, nq)
+        dq_out_spec = pl.BlockSpec((1, block_q, D), qmap)
+        dq_scratch = []
+        dq_semantics = ("parallel", "arbitrary")
+    else:
+        qmap = lambda b, i, j: (b, i, 0)
+        kmap = lambda b, i, j: (b, j, 0)
+        dq_in_specs = [
+            pl.BlockSpec((1, block_q, D), qmap),             # q
+            pl.BlockSpec((1, block_k, D), kmap),             # k
+            pl.BlockSpec((1, block_k, D), kmap),             # v
+            pl.BlockSpec((1, block_q, D), qmap),             # o
+            pl.BlockSpec((1, block_q, D), qmap),             # g
+            pl.BlockSpec((1, block_q, LANES), qmap),         # lse
+        ]
+        dq_glse_spec = pl.BlockSpec((1, block_q, LANES), qmap)
+        dq_mask_spec = pl.BlockSpec((1, 1, block_k),
+                                    lambda b, i, j: (b // H, 0, j))
+        dq_kernel = functools.partial(_bwd_dq_kernel, **common)
+        dq_grid = (B * H, nq, nk)
+        dq_out_spec = pl.BlockSpec((1, block_q, D), qmap)
+        dq_scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
+        dq_semantics = ("parallel", "parallel", "arbitrary")
     dq_operands = [qh, kh, vh, oh, gh, lse]
     if has_glse:
-        dq_in_specs.append(
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)))
+        dq_in_specs.append(dq_glse_spec)
         dq_operands.append(g_lse)
     if has_mask:
-        dq_in_specs.append(
-            pl.BlockSpec((1, 1, T), lambda b, i: (b // H, 0, 0)))
-        dq_operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
+        dq_in_specs.append(dq_mask_spec)
+        dq_operands.append(mask_op)
+    if has_dropout:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_operands.append(seed)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
-        grid=(B * H, pl.cdiv(T, block_q)),
+        dq_kernel,
+        grid=dq_grid,
         in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=dq_out_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=dq_scratch,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=dq_semantics,
         ),
         interpret=_interpret(),
     )(*dq_operands)
 
-    dkv_in_specs = [
-        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # q
-        pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # k
-        pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),   # v
-        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # o
-        pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),         # g
-        pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)),     # lse
-    ]
+    # dK/dV: resident = q/o/g/lse windows stay in VMEM (fori_loop over q
+    # blocks); streaming = grid (B·H, k block, streamed q block) — the
+    # schedule that lifts the old T<=6144 cap (the resident windows abort
+    # Mosaic at T=8192).
+    if dkv_resident:
+        kv_self = lambda b, ki: (b, ki, 0)
+        full = lambda b, ki: (b, 0, 0)
+        dkv_in_specs = [
+            pl.BlockSpec((1, T, D), full),                   # q (resident)
+            pl.BlockSpec((1, block_k, D), kv_self),          # k
+            pl.BlockSpec((1, block_k, D), kv_self),          # v
+            pl.BlockSpec((1, T, D), full),                   # o (resident)
+            pl.BlockSpec((1, T, D), full),                   # g (resident)
+            pl.BlockSpec((1, T, LANES), full),               # lse (resident)
+        ]
+        dkv_glse_spec = pl.BlockSpec((1, T, LANES), full)
+        dkv_mask_spec = pl.BlockSpec((1, 1, T), lambda b, ki: (b // H, 0, 0))
+        dkv_kernel = functools.partial(_dkv_kernel_resident, seq_len=T,
+                                       **common)
+        dkv_grid = (B * H, nk)
+        dkv_out_specs = [
+            pl.BlockSpec((1, block_k, D), kv_self),
+            pl.BlockSpec((1, block_k, D), kv_self),
+        ]
+        dkv_scratch = []
+        dkv_semantics = ("parallel", "arbitrary")
+    else:
+        kv_self = lambda b, ki, i: (b, ki, 0)
+        q_stream = lambda b, ki, i: (b, i, 0)
+        dkv_in_specs = [
+            pl.BlockSpec((1, block_q, D), q_stream),         # q
+            pl.BlockSpec((1, block_k, D), kv_self),          # k
+            pl.BlockSpec((1, block_k, D), kv_self),          # v
+            pl.BlockSpec((1, block_q, D), q_stream),         # o
+            pl.BlockSpec((1, block_q, D), q_stream),         # g
+            pl.BlockSpec((1, block_q, LANES), q_stream),     # lse
+        ]
+        dkv_glse_spec = pl.BlockSpec((1, block_q, LANES), q_stream)
+        dkv_mask_spec = pl.BlockSpec((1, 1, block_k),
+                                     lambda b, ki, i: (b // H, 0, ki))
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, **common)
+        dkv_grid = (B * H, nk, nq)
+        dkv_out_specs = [
+            pl.BlockSpec((1, block_k, D), kv_self),
+            pl.BlockSpec((1, block_k, D), kv_self),
+        ]
+        dkv_scratch = [
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ]
+        dkv_semantics = ("parallel", "parallel", "arbitrary")
     dkv_operands = [qh, kh, vh, oh, gh, lse]
     if has_glse:
-        dkv_in_specs.append(
-            pl.BlockSpec((1, T, LANES), lambda b, j: (b, 0, 0)))
+        dkv_in_specs.append(dkv_glse_spec)
         dkv_operands.append(g_lse)
     if has_mask:
-        dkv_in_specs.append(
-            pl.BlockSpec((1, 1, T), lambda b, j: (b // H, 0, 0)))
-        dkv_operands.append(kv_mask.astype(jnp.int32).reshape(B, 1, T))
+        dkv_in_specs.append(dkv_mask_spec)
+        dkv_operands.append(mask_op)
+    if has_dropout:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_operands.append(seed)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(B * H, pl.cdiv(T, block_k)),
+        dkv_kernel,
+        grid=dkv_grid,
         in_specs=dkv_in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-        ],
+        out_specs=dkv_out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
         ],
+        scratch_shapes=dkv_scratch,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=dkv_semantics,
         ),
         interpret=_interpret(),
     )(*dkv_operands)
@@ -472,54 +913,69 @@ def _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, g_lse, *, causal, scale):
             _from_heads(dv, B, H))
 
 
-def _supported(q, causal):
+def _supported(q, causal, dropout_rate=0.0):
     B, T, H, D = q.shape
     if jax.devices()[0].platform != "tpu" and not _interpret():
         return False
-    if _fit_block(T, BLOCK_Q) is None or _fit_block(T, BLOCK_K) is None:
+    if dropout_rate > 0.0 and _interpret():
+        # The TPU PRNG (prng_seed/prng_random_bits) has no interpreter
+        # lowering; CPU tests of dropout exercise the dense fallback, the
+        # kernel PRNG path is validated on hardware
+        # (scripts/validate_tpu.py: validate_kernel_dropout).
         return False
-    # The backward kernels keep full-T q/o/g/lse windows resident per
-    # program; at T = 8192 with H >= 8 the Mosaic compiler aborts (VMEM
-    # window allocation; measured on v5e 2026-07-30 — T=6144 x 16 heads
-    # compiles, 8192 x 8 does not).  Reject so callers get the dense /
-    # ring-chunked fallback instead of an INTERNAL compile error; sequences
-    # this long belong on the ring path (sharded to <= 4k per chip) anyway.
-    if T > 6144 and not _interpret():
+    if _fit_block(T, BLOCK_Q) is None or _fit_block(T, BLOCK_K) is None:
         return False
     return D in (64, 128, 256) or D % 128 == 0 or _interpret()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, kv_mask, causal, scale):
-    if _supported(q, causal):
+def _dense_from_seed(q, k, v, kv_mask, seed, *, causal, scale, dropout_rate):
+    """Dense fallback honoring the kernel API's (seed, rate) dropout args:
+    same distribution as the in-kernel PRNG, different mask realization."""
+    rng = None
+    if dropout_rate > 0.0 and seed is not None:
+        rng = jax.random.PRNGKey(seed[0].astype(jnp.uint32))
+    return _dense(q, k, v, causal=causal, scale=scale, kv_mask=kv_mask,
+                  dropout_rate=dropout_rate, dropout_rng=rng)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, kv_mask, seed, causal, scale, dropout_rate):
+    if _supported(q, causal, dropout_rate):
         out, _ = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
-                                save_lse=False)
+                                save_lse=False, dropout_rate=dropout_rate,
+                                seed=seed)
         return out
-    return _dense(q, k, v, causal=causal, scale=scale, kv_mask=kv_mask)
+    return _dense_from_seed(q, k, v, kv_mask, seed, causal=causal,
+                            scale=scale, dropout_rate=dropout_rate)
 
 
-def _flash_fwd(q, k, v, kv_mask, causal, scale):
-    if _supported(q, causal):
+def _flash_fwd(q, k, v, kv_mask, seed, causal, scale, dropout_rate):
+    if _supported(q, causal, dropout_rate):
         out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal,
-                                  scale=scale, save_lse=True)
-        return out, (q, k, v, kv_mask, out, lse)
-    return (_dense(q, k, v, causal=causal, scale=scale, kv_mask=kv_mask),
-            (q, k, v, kv_mask, None, None))
+                                  scale=scale, save_lse=True,
+                                  dropout_rate=dropout_rate, seed=seed)
+        return out, (q, k, v, kv_mask, seed, out, lse)
+    return (_dense_from_seed(q, k, v, kv_mask, seed, causal=causal,
+                             scale=scale, dropout_rate=dropout_rate),
+            (q, k, v, kv_mask, seed, None, None))
 
 
-def _flash_bwd(causal, scale, res, g):
-    q, k, v, kv_mask, o, lse = res
+def _flash_bwd(causal, scale, dropout_rate, res, g):
+    q, k, v, kv_mask, seed, o, lse = res
     if o is None:
-        # Fallback path (non-TPU / awkward shapes): XLA autodiff of dense.
+        # Fallback path (non-TPU / awkward shapes): XLA autodiff of dense,
+        # with the SAME seed-derived dropout mask as the fallback forward.
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _dense(q_, k_, v_, causal=causal, scale=scale,
-                                      kv_mask=kv_mask),
+            lambda q_, k_, v_: _dense_from_seed(
+                q_, k_, v_, kv_mask, seed, causal=causal, scale=scale,
+                dropout_rate=dropout_rate),
             q, k, v,
         )
-        return vjp(g) + (None,)
+        return vjp(g) + (None, None)
     dq, dk, dv = _flash_bwd_tpu(q, k, v, o, lse, g, kv_mask, None,
-                                causal=causal, scale=scale)
-    return dq, dk, dv, None
+                                causal=causal, scale=scale,
+                                dropout_rate=dropout_rate, seed=seed)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -569,16 +1025,31 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     kv_mask: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Fused attention. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
     ``kv_mask``: optional (B, Tk) key-validity mask (>0 = real token) — the
     reference stack's per-op ``attention_mask`` input (BERT ``input_mask``
     semantics: masks KEYS only, broadcasting over queries).
+
+    ``dropout_rate``/``dropout_rng``: attention-probability dropout (the
+    reference models' regularizer).  On the kernel path the keep mask is
+    generated in-kernel by the TPU PRNG, seeded from ``dropout_rng`` per
+    score tile, and regenerated identically in the backward kernels.  The
+    dense fallback uses ``jax.random`` (same distribution, different mask
+    realization).  ``dropout_rate=0`` (default) compiles the dropout-free
+    kernels.
     """
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    return _flash(q, k, v, kv_mask, causal, scale)
+    seed = None
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        seed = _seed_operand(dropout_rng)
+    return _flash(q, k, v, kv_mask, seed, causal, scale, float(dropout_rate))
 
 
 def flash_attention_with_lse(
@@ -596,7 +1067,8 @@ def flash_attention_with_lse(
     scores.  The building block for ring attention's cross-block combine:
     out_total = Σ_blocks out_b · exp(lse_b − logsumexp_b lse_b) is exact.
     Rows with zero valid keys yield out = 0, lse = -1e30 (an exact no-op
-    under that combine).
+    under that combine).  No dropout on this path — the ring combine
+    assumes exact per-block softmax statistics.
     """
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))

@@ -52,8 +52,8 @@ class TrainArgs:
     model: str = "mnist"
     arch: Optional[str] = None  # sub-architecture (wide_deep | dlrm)
     flash_attention: bool = False  # gpt2: Pallas fused attention, forward
-    # and backward (~6.6x tokens/s vs dense+accum on v5e; drops
-    # attention-prob dropout — see GPT2Config)
+    # and backward (~6.6x tokens/s vs dense+accum on v5e; attention-prob
+    # dropout runs in-kernel — see GPT2Config)
     ring_chunk_size: int = 0  # gpt2/bert with --context>1: kv-chunk size
     # bounding per-ring-step attention memory (0 = whole blocks)
     steps: int = 200
@@ -94,7 +94,7 @@ def parse_args(argv=None) -> TrainArgs:
                    help="gpt2: use the Pallas fused-attention kernels "
                         "(forward AND backward — no (T,T) score buffer in "
                         "either pass; ~6.6x tokens/s vs dense+accum on "
-                        "v5e; drops attention-prob dropout)")
+                        "v5e; attention-prob dropout runs in-kernel)")
     p.add_argument("--ring_chunk_size", type=int, default=0,
                    help="gpt2/bert with --context>1: consume ring-attention "
                         "kv blocks in chunks of this many keys (bounds "

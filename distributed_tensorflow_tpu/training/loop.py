@@ -196,10 +196,41 @@ class TrainLoop:
         self.metrics_every = max(1, metrics_every)
         self.rng = rng if rng is not None else jax.random.key(0)
         self.last_logged_metrics: Dict[str, float] = {}
+        self.last_step_metrics: Optional[Dict[str, float]] = None
         self._stop = False
 
     def request_stop(self) -> None:
         self._stop = True
+
+    def run_one_step(self, completed_steps: int, train_step=None) -> int:
+        """One step: feed a batch, run the compiled step, drive hooks.
+
+        Returns the new completed-step count.  Shared by ``run`` and the
+        TF1 ``compat.v1.MonitoredTrainingSession.run`` so both loop bodies
+        are the same code.  An exhausted data iterator requests stop (the
+        TF1 OutOfRangeError-ends-the-session contract) and leaves the count
+        unchanged.
+        """
+        fn = train_step if train_step is not None else self.train_step
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.request_stop()
+            self.last_step_metrics = None
+            return completed_steps
+        self.rng, step_rng = jax.random.split(self.rng)
+        self.state, metrics = fn(self.state, batch, step_rng)
+        completed_steps += 1
+        host_metrics = None
+        if completed_steps % self.metrics_every == 0:
+            host_metrics = {
+                k: float(np.asarray(jax.device_get(v)))
+                for k, v in metrics.items()
+            }
+        for h in self.hooks:
+            h.after_step(self, completed_steps, host_metrics)
+        self.last_step_metrics = host_metrics
+        return completed_steps
 
     def run(self, num_steps: int) -> TrainState:
         for h in self.hooks:
@@ -207,21 +238,10 @@ class TrainLoop:
         start = int(jax.device_get(self.state.step))
         completed = start  # last step the state actually reflects
         try:
-            for step in range(start, start + num_steps):
+            for _ in range(num_steps):
                 if self._stop:
                     break
-                batch = next(self.data_iter)
-                self.rng, step_rng = jax.random.split(self.rng)
-                self.state, metrics = self.train_step(self.state, batch, step_rng)
-                completed = step + 1
-                host_metrics = None
-                if completed % self.metrics_every == 0:
-                    host_metrics = {
-                        k: float(np.asarray(jax.device_get(v)))
-                        for k, v in metrics.items()
-                    }
-                for h in self.hooks:
-                    h.after_step(self, completed, host_metrics)
+                completed = self.run_one_step(completed)
         finally:
             for h in self.hooks:
                 h.end(self, completed)

@@ -22,10 +22,12 @@ reference idiom                            what runs here
 ``SyncReplicasOptimizer(opt, N)``          sync aggregation of N microbatch
                                            grads via optax.MultiSteps inside
                                            the compiled step
-``MonitoredTrainingSession(master=...)``   chief-only CheckpointManager +
-                                           hook list driving TrainLoop
-``sess.run(train_op)`` hot loop            one compiled XLA step (allreduce
-                                           on ICI, no gRPC RecvTensor)
+``MonitoredTrainingSession(master=...)``   a REAL session: restore-on-enter,
+                                           hooks, chief-file-owned orbax
+                                           checkpointing, should_stop()
+``sess.run(train_op)`` hot loop            runs VERBATIM; each run() is one
+                                           compiled XLA step (allreduce on
+                                           ICI, no gRPC RecvTensor)
 =========================================  ==================================
 
 Run single-process (also what tests/test_examples.py does)::
@@ -59,12 +61,7 @@ from distributed_tensorflow_tpu.data import (
 from distributed_tensorflow_tpu.models import get_workload
 from distributed_tensorflow_tpu.models.bert import BertConfig
 from distributed_tensorflow_tpu.train_lib import build_state_and_step
-from distributed_tensorflow_tpu.training import (
-    CheckpointHook,
-    LoggingHook,
-    NanHook,
-    TrainLoop,
-)
+from distributed_tensorflow_tpu.training import LoggingHook, NanHook
 
 
 def parse_flags(argv=None):
@@ -137,20 +134,6 @@ def main(argv=None):
         workload, mesh, total_steps=flags.train_steps
     )
 
-    # 5. MonitoredTrainingSession — chief-only checkpointing + hooks.
-    manager, hooks = tf1.MonitoredTrainingSession(
-        master=server.target,
-        is_chief=is_chief,
-        checkpoint_dir=flags.checkpoint_dir,
-        hooks=[LoggingHook(every_steps=flags.log_every), NanHook()],
-        save_checkpoint_steps=max(1, flags.train_steps // 2),
-    )
-    hooks.append(opt.make_session_run_hook(is_chief))
-    if manager is not None:
-        hooks.append(
-            CheckpointHook(manager, every_steps=max(1, flags.train_steps // 2))
-        )
-
     host_bs = per_host_batch_size(workload.batch_size)
     data_iter = DevicePrefetchIterator(
         workload.data_fn(host_bs),
@@ -158,18 +141,36 @@ def main(argv=None):
         prefetch=2,
     )
 
-    # 6. The sess.run(train_op) loop.
-    loop = TrainLoop(
-        train_step,
-        state,
-        data_iter,
+    # 5+6. MonitoredTrainingSession — the reference's VERBATIM hot loop:
+    #    with MonitoredTrainingSession(...) as sess:
+    #        while not sess.should_stop():
+    #            sess.run(train_op)
+    # train_op is the compiled step; StopAtStepHook bounds the loop exactly
+    # as in TF1; checkpointing is chief-file-owned via orbax inside the
+    # session.
+    train_op = train_step
+    hooks = [
+        tf1.StopAtStepHook(last_step=flags.train_steps),
+        LoggingHook(every_steps=flags.log_every),
+        NanHook(),
+        opt.make_session_run_hook(is_chief),
+    ]
+    with tf1.MonitoredTrainingSession(
+        master=server.target,
+        is_chief=is_chief,
+        checkpoint_dir=flags.checkpoint_dir,
         hooks=hooks,
+        save_checkpoint_steps=max(1, flags.train_steps // 2),
+        state=state,
+        data_iter=data_iter,
         examples_per_step=workload.batch_size,
         metrics_every=min(5, flags.log_every),
-    )
-    loop.run(flags.train_steps)
-    loss = loop.last_logged_metrics.get("loss")
+    ) as sess:
+        while not sess.should_stop():
+            sess.run(train_op)
+    loss = sess.last_logged_metrics.get("loss")
     print(f"TF1_PS_LAUNCHER_DONE loss={loss}", flush=True)
+    data_iter.close()
     server.shutdown()
     return loss
 

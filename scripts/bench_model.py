@@ -83,10 +83,12 @@ def main(argv=None):
     # Scalar-pull fence (see bench.py): block_until_ready does not actually
     # block through the axon tunnel.
     jax.device_get(metrics["loss"])
+    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
     t0 = time.perf_counter()
     for _ in range(args.iters):
         state, metrics = train_step(state, batch, rng)
     jax.device_get(metrics["loss"])
+    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
     dt = time.perf_counter() - t0
 
     ex_per_sec = args.iters * wl.batch_size / dt

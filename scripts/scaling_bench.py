@@ -102,11 +102,13 @@ def main():
             # Scalar-pull fence (see bench.py): block_until_ready does not
             # actually block through the axon tunnel.
             jax.device_get(m["loss"])
+            jax.device_get(state.step)  # fence covers the update (ADVICE r3)
         t0 = time.perf_counter()
         for i in range(args.iters):
             state, m = step(state, next(data_iter),
                             jax.random.fold_in(rng, 99 + i))
         jax.device_get(m["loss"])
+        jax.device_get(state.step)  # fence covers the update (ADVICE r3)
         dt = time.perf_counter() - t0
         close = getattr(data_iter, "close", None)
         if callable(close):

@@ -70,12 +70,12 @@ def main(argv=None):
         )
         from distributed_tensorflow_tpu.data.records import (
             record_data_fn,
-            record_path,
+            record_paths,
             record_schema,
             stage_synthetic_to_records,
         )
 
-        path = record_path(flags.data_dir, wl.name)
+        path = record_paths(flags.data_dir, wl.name)
         want = record_schema(wl).file_size(flags.records)
         if not (os.path.exists(path) and os.path.getsize(path) == want):
             stage_synthetic_to_records(wl, path, flags.records)

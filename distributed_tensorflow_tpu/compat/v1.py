@@ -204,7 +204,7 @@ class StopAtStepHook(Hook):
             loop.request_stop()
 
 
-class MonitoredTrainingSession(TrainLoop):
+class MonitoredTrainingSession:
     """$TF/python/training/monitored_session.py:428 — a REAL session object.
 
     The reference's hot-loop idiom runs verbatim::
@@ -235,6 +235,11 @@ class MonitoredTrainingSession(TrainLoop):
     - Hooks are ``training.loop.Hook``s (the SessionRunHook equivalent);
       all of Logging/Nan/Checkpoint/Profiler/Eval work unchanged, plus
       ``StopAtStepHook`` above for loop bounding.
+
+    Composes (does NOT subclass) a ``TrainLoop``: the TF1 surface's
+    ``run(train_op)`` is a different contract than ``TrainLoop.run(
+    num_steps)``, so substituting one for the other must be a type error,
+    not a runtime surprise.  The loop object is what hooks observe.
     """
 
     def __init__(
@@ -274,7 +279,7 @@ class MonitoredTrainingSession(TrainLoop):
                 CheckpointHook(self._manager,
                                every_steps=save_checkpoint_steps)
             )
-        super().__init__(
+        self._loop = TrainLoop(
             train_step=None,  # the op arrives per sess.run(train_op)
             state=state,
             data_iter=data_iter,
@@ -288,15 +293,28 @@ class MonitoredTrainingSession(TrainLoop):
         self._closed = False
         self._step = 0
 
+    # The session's observable state IS the loop's (hooks mutate it).
+    @property
+    def state(self):
+        return self._loop.state
+
+    @property
+    def hooks(self):
+        return self._loop.hooks
+
+    @property
+    def last_logged_metrics(self):
+        return self._loop.last_logged_metrics
+
     def should_stop(self) -> bool:
-        return self._stop
+        return self._loop._stop
 
     def __enter__(self) -> "MonitoredTrainingSession":
         if self._manager is not None:
-            self.state = self._manager.restore_or_init(self.state)
-        self._step = int(jax.device_get(self.state.step))
-        for h in self.hooks:
-            h.begin(self)
+            self._loop.state = self._manager.restore_or_init(self._loop.state)
+        self._step = int(jax.device_get(self._loop.state.step))
+        for h in self._loop.hooks:
+            h.begin(self._loop)
         return self
 
     def run(self, train_op, *_unused_fetches):
@@ -306,19 +324,19 @@ class MonitoredTrainingSession(TrainLoop):
         otherwise — other steps stay fully async on device, the same
         throttling as ``TrainLoop``, whose ``run_one_step`` this drives).
         """
-        if self._stop:
+        if self._loop._stop:
             raise RuntimeError(
                 "run() called after should_stop() requested stop"
             )
-        self._step = self.run_one_step(self._step, train_step=train_op)
-        return self.last_step_metrics
+        self._step = self._loop.run_one_step(self._step, train_step=train_op)
+        return self._loop.last_step_metrics
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        for h in self.hooks:
-            h.end(self, self._step)
+        for h in self._loop.hooks:
+            h.end(self._loop, self._step)
         if self._manager is not None:
             self._manager.close()
 

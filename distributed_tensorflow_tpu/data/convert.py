@@ -147,14 +147,21 @@ def convert_tfrecords(
     limit: Optional[int] = None,
     chunk: int = 512,
     verify: bool = False,
+    num_output_files: int = 1,
 ) -> int:
     """Convert TFRecord shards into the workload's RecordFile at out_path.
 
     ``transform`` maps one parsed example to the workload's per-example
     field dict (decode/resize/relabel here); identity when the TFRecord
-    features already match the schema.  Returns examples written.
+    features already match the schema.  ``num_output_files > 1`` writes a
+    ``{name}-NNNNN-of-MMMMM.rec`` fileset next to ``out_path`` (examples
+    round-robined), the layout FILE auto-shard and the dispatcher's
+    file-group assignment consume.  Returns examples written.
     """
-    from distributed_tensorflow_tpu.data.records import record_schema
+    from distributed_tensorflow_tpu.data.records import (
+        fileset_paths,
+        record_schema,
+    )
 
     import os
 
@@ -162,10 +169,15 @@ def convert_tfrecords(
     schema = record_schema(workload)
     staged_fields = {n: (s, d) for n, s, d in schema.fields}
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    # Atomic output: chunks stream into .tmp; the final rename publishes a
-    # complete file (a crashed conversion never leaves a partial .rec a
-    # loader would happily serve).
-    tmp_path = out_path + ".tmp"
+    out_paths = fileset_paths(out_path, num_output_files)
+    # Atomic output: chunks stream into .tmp; the final rename publishes
+    # complete files (a crashed conversion never leaves a partial .rec a
+    # loader would happily serve).  Stale tmps from a crashed prior run
+    # must not survive into this run's publish step.
+    tmp_paths = [p + ".tmp" for p in out_paths]
+    for tp in tmp_paths:
+        if os.path.exists(tp):
+            os.unlink(tp)
 
     def example_stream() -> Iterator[Dict[str, np.ndarray]]:
         for path in tfrecord_paths:
@@ -174,11 +186,11 @@ def convert_tfrecords(
                 yield transform(ex) if transform is not None else ex
 
     written = 0
-    first = True
+    first = [True] * len(tmp_paths)
     batch: Dict[str, list] = {n: [] for n in staged_fields}
 
     def flush():
-        nonlocal written, first
+        nonlocal written
         if not next(iter(batch.values())):
             return
         arrays = {}
@@ -189,9 +201,17 @@ def convert_tfrecords(
             arrays[name] = np.asarray(b[name], dtype=dtype).reshape(
                 (-1,) + tuple(shape)
             )
-        schema.write(tmp_path, arrays, append=not first)
-        first = False
-        written += len(next(iter(arrays.values())))
+        n_rows = len(next(iter(arrays.values())))
+        for fi, tp in enumerate(tmp_paths):
+            # row j (global index written + j) -> file (written + j) % M
+            rows = [j for j in range(n_rows)
+                    if (written + j) % len(tmp_paths) == fi]
+            if not rows:
+                continue
+            sub = {k: v[rows] for k, v in arrays.items()}
+            schema.write(tp, sub, append=not first[fi])
+            first[fi] = False
+        written += n_rows
         for v in batch.values():
             v.clear()
 
@@ -212,6 +232,17 @@ def convert_tfrecords(
             flush()
     flush()
     if written:
-        os.replace(tmp_path, out_path)
-    logger.info("converted %d examples -> %s", written, out_path)
+        missing = [p for tp, p in zip(tmp_paths, out_paths)
+                   if not os.path.exists(tp)]
+        if missing:
+            # A fileset whose -of-MMMMM names overstate its membership
+            # would shift every FILE-shard assignment; refuse instead.
+            raise ValueError(
+                f"only {written} example(s) for {len(out_paths)} output "
+                f"files — members {sorted(os.path.basename(p) for p in missing)} "
+                "would be empty; lower num_output_files")
+        for tp, p in zip(tmp_paths, out_paths):
+            os.replace(tp, p)
+    logger.info("converted %d examples -> %s (%d file(s))", written,
+                out_paths[0], len(out_paths))
     return written

@@ -11,9 +11,12 @@ lean:
   NOT on the streaming path — after a client has its worker list, the
   dispatcher can die without affecting training (metadata-plane/data-plane
   separation, same as tf.data service).
-- Workers are plain ``DataServiceServer``s, each owning ONE record-stripe
-  shard (``shard_index``/``shard_count`` into the native loader), so the
-  union of workers covers the file exactly once per epoch.
+- Workers are plain ``DataServiceServer``s, each owning one shard of the
+  dataset (``shard_index``/``shard_count`` into the native loader): a
+  record stripe for single-file datasets (DATA), a whole FILE GROUP for
+  ``{name}-NNNNN-of-MMMMM.rec`` filesets (FILE — tf.data auto-shard
+  roles), so the union of workers covers the dataset exactly once per
+  epoch.
 - ``DistributedDataServiceIterator``: connects to every worker and
   round-robins batches.  A worker that dies mid-stream is dropped with a
   warning and the remaining workers keep feeding (that shard's un-served

@@ -42,7 +42,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from distributed_tensorflow_tpu.native import NativeRecordLoader, RecordFile
+from distributed_tensorflow_tpu.native import RecordFile, make_record_loader
 
 logger = logging.getLogger(__name__)
 
@@ -78,7 +78,7 @@ class DataServiceServer:
 
     def __init__(
         self,
-        path: str,
+        path,
         record: RecordFile,
         *,
         batch_size: int,
@@ -90,6 +90,7 @@ class DataServiceServer:
         seed: int = 0,
         shard_index: int = 0,
         shard_count: int = 1,
+        policy: str = "auto",
     ):
         if shard_count < 1 or not (0 <= shard_index < shard_count):
             raise ValueError(
@@ -98,14 +99,18 @@ class DataServiceServer:
                 "(shards are 0-based)")
         self.record = record
         self.batch_size = batch_size
-        # Standalone (shard 0/1): the service owns the WHOLE file —
+        # Standalone (shard 0/1): the service owns the WHOLE dataset —
         # trainers split the stream by pulling, not by record striping.
-        # Under a dispatcher (data/dispatcher.py), each worker owns ONE
-        # record-stripe shard and clients interleave across workers.
-        self._loader = NativeRecordLoader(
+        # Under a dispatcher (data/dispatcher.py), each worker owns its
+        # shard of the dataset and clients interleave across workers: for
+        # a multi-file dataset that shard is a FILE GROUP (files
+        # i % shard_count — tf.data FILE auto-shard), for a single file a
+        # record stripe (DATA); ``policy`` forces either.
+        self._loader = make_record_loader(
             path, record, batch_size=batch_size, shuffle=shuffle,
             num_threads=num_threads, prefetch=prefetch, seed=seed,
             shard_index=shard_index, shard_count=shard_count,
+            policy=policy,
         )
         self._loader_lock = threading.Lock()
         self._sock = socket.create_server((host, port))
@@ -326,7 +331,7 @@ def main(argv=None):
     import argparse
 
     from distributed_tensorflow_tpu.data.records import (
-        record_path,
+        record_paths,
         record_schema,
     )
 
@@ -345,6 +350,11 @@ def main(argv=None):
                    help="worker: register with this dispatcher host:port")
     p.add_argument("--shard_index", type=int, default=0)
     p.add_argument("--shard_count", type=int, default=1)
+    p.add_argument("--auto_shard_policy", choices=("auto", "file", "data"),
+                   default="auto",
+                   help="multi-file datasets: each worker serves whole "
+                        "file groups (file), record stripes (data), or "
+                        "file-when-enough-files (auto)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, force=True)
@@ -365,7 +375,7 @@ def main(argv=None):
 
     workload = get_workload(args.model)
     server = DataServiceServer(
-        record_path(args.data_dir, args.model),
+        record_paths(args.data_dir, args.model),
         record_schema(workload),
         batch_size=args.batch_size,
         host=args.host,
@@ -374,6 +384,7 @@ def main(argv=None):
         num_threads=args.num_threads,
         shard_index=args.shard_index,
         shard_count=args.shard_count,
+        policy=args.auto_shard_policy,
     ).start()
     if args.dispatcher:
         from distributed_tensorflow_tpu.data.dispatcher import (

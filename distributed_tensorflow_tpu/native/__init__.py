@@ -11,7 +11,15 @@ threaded shuffle/batch/prefetch loader compiled from
 from distributed_tensorflow_tpu.native.loader import (
     NativeRecordLoader,
     RecordFile,
+    RecordSetLoader,
+    make_record_loader,
     native_available,
 )
 
-__all__ = ["NativeRecordLoader", "RecordFile", "native_available"]
+__all__ = [
+    "NativeRecordLoader",
+    "RecordFile",
+    "RecordSetLoader",
+    "make_record_loader",
+    "native_available",
+]

@@ -166,6 +166,163 @@ class RecordFile:
         return out
 
 
+class RecordSetLoader:
+    """Multi-file record loader with tf.data's auto-shard policies.
+
+    The reference's input pipelines read 1024-shard filesets
+    ($TF/python/data/ops/options.py:89 ``AutoShardPolicy``,
+    input_lib.py:729 — SURVEY.md §3.4); this is the native-loader
+    equivalent over ``{name}-NNNNN-of-MMMMM.rec`` filesets:
+
+    - ``FILE``: whole files are assigned round-robin (file i -> shard
+      ``i % shard_count``); each shard reads only its own files.  Raises if
+      a shard would get no files (tf.data's FILE error contract).
+    - ``DATA``: records stripe globally across the concatenated fileset
+      (record j -> shard ``j % shard_count``), implemented exactly with
+      per-file stripe offsets from the cumulative record counts.
+    - ``AUTO``: FILE when every shard gets at least one file, else DATA
+      (tf.data's AUTO fallback order).
+
+    Batches are drawn from the shard's per-file loaders by a seeded
+    size-weighted choice, so large files contribute proportionally.
+    """
+
+    POLICIES = ("auto", "file", "data")
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        record: RecordFile,
+        *,
+        batch_size: int,
+        shuffle: bool = True,
+        num_threads: int = 2,
+        prefetch: int = 4,
+        seed: int = 0,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
+        policy: str = "auto",
+    ):
+        import jax
+
+        if policy not in self.POLICIES:
+            raise ValueError(f"policy must be one of {self.POLICIES}, "
+                             f"got {policy!r}")
+        paths = list(paths)
+        if not paths:
+            raise FileNotFoundError("empty record fileset")
+        self.record = record
+        self.batch_size = batch_size
+        s = shard_index if shard_index is not None else jax.process_index()
+        n = shard_count if shard_count is not None else jax.process_count()
+        if policy == "auto":
+            policy = "file" if len(paths) >= n else "data"
+        self.policy = policy
+
+        # Record counts from file sizes (no read): the header guard in each
+        # NativeRecordLoader still validates the schema byte-for-byte.
+        counts = []
+        for p in paths:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"no record file at {p!r}")
+            payload = os.path.getsize(p) - RECORD_HEADER_BYTES
+            if payload < 0 or payload % record.record_bytes:
+                raise ValueError(
+                    f"{p!r}: payload is not a whole number of "
+                    f"{record.record_bytes}-byte records — schema mismatch")
+            counts.append(payload // record.record_bytes)
+
+        self._loaders: list = []
+        weights = []
+        if policy == "file":
+            mine = [(p, c) for i, (p, c) in enumerate(zip(paths, counts))
+                    if i % n == s]
+            if not mine:
+                raise FileNotFoundError(
+                    f"FILE sharding: shard {s}/{n} gets no files from a "
+                    f"{len(paths)}-file set; add files or use DATA policy")
+            # Thread/prefetch budgets are for the SHARD, not per file — a
+            # 1024-file set must not spawn 2048 producer threads.
+            per_t = max(1, num_threads // len(mine))
+            per_p = max(2, prefetch // len(mine))
+            for fidx, (p, c) in enumerate(mine):
+                self._loaders.append(NativeRecordLoader(
+                    p, record, batch_size=batch_size, shuffle=shuffle,
+                    num_threads=per_t, prefetch=per_p,
+                    seed=seed + 7919 * fidx, shard_index=0, shard_count=1,
+                ))
+                weights.append(c)
+        else:  # data: exact global striping via per-file offsets
+            per_t = max(1, num_threads // len(paths))
+            per_p = max(2, prefetch // len(paths))
+            offset = 0
+            for fidx, (p, c) in enumerate(zip(paths, counts)):
+                local = (s - offset) % n
+                stripe = (c - local + n - 1) // n if local < c else 0
+                offset += c
+                if stripe == 0:
+                    continue
+                self._loaders.append(NativeRecordLoader(
+                    p, record, batch_size=batch_size, shuffle=shuffle,
+                    num_threads=per_t, prefetch=per_p,
+                    seed=seed + 7919 * fidx, shard_index=local,
+                    shard_count=n,
+                ))
+                weights.append(stripe)
+            if not self._loaders:
+                raise FileNotFoundError(
+                    f"DATA sharding: shard {s}/{n} holds no records across "
+                    f"the {len(paths)}-file set")
+        self.num_records = sum(weights)
+        self._weights = np.asarray(weights, np.float64)
+        self._credits = np.zeros_like(self._weights)
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        return self.record.unpack(self.next_raw())
+
+    def next_raw(self) -> np.ndarray:
+        # Credit scheduler: each file earns its record count per epoch and
+        # pays batch_size per draw, so files contribute proportionally and
+        # an unshuffled stream covers each epoch exactly (when file sizes
+        # are batch-aligned) — shuffled streams pick credit-weighted at
+        # random, unshuffled take the largest remaining credit.
+        if self._credits.sum() <= 0:
+            self._credits = self._weights.copy()
+        if self._shuffle:
+            p = np.clip(self._credits, 0, None)
+            pick = int(self._rng.choice(len(self._loaders), p=p / p.sum()))
+        else:
+            pick = int(np.argmax(self._credits))
+        self._credits[pick] -= self.batch_size
+        return self._loaders[pick].next_raw()
+
+    def close(self) -> None:
+        for ld in self._loaders:
+            ld.close()
+
+
+def make_record_loader(paths, record: RecordFile, **kw):
+    """One loader for a single path or a fileset.
+
+    ``paths`` may be a string (one file — plain ``NativeRecordLoader``,
+    the ``policy`` kwarg is dropped since striping is the only choice) or
+    a sequence of paths (``RecordSetLoader`` with FILE/DATA/AUTO).
+    """
+    if isinstance(paths, (str, os.PathLike)):
+        kw.pop("policy", None)
+        return NativeRecordLoader(os.fspath(paths), record, **kw)
+    paths = list(paths)
+    if len(paths) == 1:
+        kw.pop("policy", None)
+        return NativeRecordLoader(paths[0], record, **kw)
+    return RecordSetLoader(paths, record, **kw)
+
+
 class NativeRecordLoader:
     """Iterator of shuffled, sharded, prefetched batches from a RecordFile.
 

@@ -72,7 +72,10 @@ class TrainArgs:
     job_name: Optional[str] = None
     task_index: Optional[int] = None
     # io
-    data_dir: Optional[str] = None  # {data_dir}/{model}.rec -> native loader
+    data_dir: Optional[str] = None  # {model}.rec or {model}-NNNNN-of-MMMMM
+    # fileset in this dir -> native loader
+    auto_shard_policy: str = "auto"  # fileset sharding: auto|file|data
+    # (tf.data AutoShardPolicy roles; single-file datasets always stripe)
     data_service: Optional[str] = None  # host:port of a data.service server
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
@@ -113,9 +116,16 @@ def parse_args(argv=None) -> TrainArgs:
                    help="TF1 launcher contract: ps|worker|chief|evaluator")
     p.add_argument("--task_index", type=int, default=None)
     p.add_argument("--data_dir", type=str, default=None,
-                   help="directory of {model}.rec record files; enables the "
+                   help="directory holding {model}.rec or a "
+                        "{model}-NNNNN-of-MMMMM.rec fileset; enables the "
                         "native C++ input loader (falls back to synthetic "
                         "data when unset)")
+    p.add_argument("--auto_shard_policy", choices=("auto", "file", "data"),
+                   default="auto",
+                   help="multi-file dataset sharding across hosts: whole "
+                        "files (file), record striping (data), or file-"
+                        "when-enough-files (auto) — the tf.data "
+                        "AutoShardPolicy roles")
     p.add_argument("--data_service", type=str, default=None,
                    help="host:port of an out-of-process input server "
                         "(data.service — the tf.data-service role); "
@@ -371,14 +381,16 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     elif args.data_dir:
         from distributed_tensorflow_tpu.data.records import (
             record_data_fn,
-            record_path,
+            record_paths,
         )
 
-        path = record_path(args.data_dir, args.model)
-        logger.info("native record loader: %s", path)
+        paths = record_paths(args.data_dir, args.model)
+        logger.info("native record loader: %d file(s), %s%s", len(paths),
+                    paths[0], "" if len(paths) == 1 else " ..")
         host_iter = record_data_fn(
-            path, workload, seed=args.seed,
+            paths, workload, seed=args.seed,
             shard_index=stream_index, shard_count=stream_shards,
+            policy=args.auto_shard_policy,
         )(host_bs)
     else:
         host_iter = workload.data_fn(host_bs)

@@ -72,6 +72,8 @@ def summarize_xplane(trace_dir: str, top: int = 14) -> None:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2")
+    p.add_argument("--arch", default=None,
+                   help="wide_deep only: wide_deep | dlrm")
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--grad_accum_steps", type=int, default=1)
@@ -90,10 +92,11 @@ def main():
     from distributed_tensorflow_tpu.training import BF16
 
     mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=1))
+    kw = {"arch": args.arch} if args.arch else {}
     wl = get_workload(
         args.model, batch_size=args.batch_size, seq_len=args.seq_len,
         grad_accum_steps=args.grad_accum_steps,
-        use_flash_attention=args.flash_attention or None, mesh=mesh,
+        use_flash_attention=args.flash_attention or None, mesh=mesh, **kw,
     )
     state, _, train_step, batch_sh = build_state_and_step(
         wl, mesh, precision=BF16, grad_accum_steps=args.grad_accum_steps,

@@ -73,12 +73,12 @@ def main():
             )
             from distributed_tensorflow_tpu.data.records import (
                 record_data_fn,
-                record_path,
+                record_paths,
                 record_schema,
                 stage_synthetic_to_records,
             )
 
-            path = record_path(args.data_dir, wl.name)
+            path = record_paths(args.data_dir, wl.name)
             want = record_schema(wl).file_size(args.records)
             if not (os.path.exists(path) and os.path.getsize(path) == want):
                 stage_synthetic_to_records(wl, path, args.records)

@@ -3,6 +3,7 @@
 TensorFlow's writer, converts them, and trains through the native loader.
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -183,3 +184,48 @@ def test_limit_and_missing_field_error(tmp_path):
     _write_tfrecord(p2, [{"image": np.zeros(784, np.float32)}])
     with pytest.raises(ValueError, match="lacks schema fields"):
         convert_tfrecords([str(p2)], str(tmp_path / "bad.rec"), workload=wl)
+
+
+def test_convert_to_fileset_then_train_file_sharded(tmp_path):
+    """TFRecords -> {name}-NNNNN-of-MMMMM.rec fileset (num_output_files) ->
+    FILE-policy training (VERDICT r3 #4)."""
+    from distributed_tensorflow_tpu.data.records import record_paths
+    from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+    wl = get_workload("mnist", batch_size=16)
+    rng = np.random.RandomState(2)
+    n = 96
+    examples = [
+        {"image": rng.randn(28, 28, 1).astype(np.float32),
+         "label": np.int64(rng.randint(10))}
+        for _ in range(n)
+    ]
+    _write_tfrecord(tmp_path / "train-00000", examples)
+
+    def transform(ex):
+        return {
+            "image": ex["image"].reshape(28, 28, 1).astype(np.float32),
+            "label": ex["label"].astype(np.int32)[0],
+        }
+
+    out = record_path(str(tmp_path / "staged"), "mnist")
+    wrote = convert_tfrecords(
+        [str(tmp_path / "train-00000")], out, workload=wl,
+        transform=transform, num_output_files=4,
+    )
+    assert wrote == n
+    paths = record_paths(str(tmp_path / "staged"), "mnist")
+    assert len(paths) == 4
+    # round-robin split: 24 records per member
+    from distributed_tensorflow_tpu.data.records import record_schema
+    schema = record_schema(wl)
+    for p in paths:
+        payload = os.path.getsize(p) - 16
+        assert payload // schema.record_bytes == n // 4
+
+    result = run(TrainArgs(
+        model="mnist", steps=4, batch_size=16, log_every=2,
+        data_dir=str(tmp_path / "staged"), auto_shard_policy="file",
+    ))
+    assert result["final_step"] == 4
+    assert np.isfinite(result["loss"])

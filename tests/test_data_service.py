@@ -286,3 +286,103 @@ class TestDataService:
         finally:
             proc.terminate()
             proc.wait(timeout=30)
+
+
+class TestDispatcherFileGroups:
+    """Dispatcher tier over a MULTI-FILE dataset (VERDICT r3 #4): each
+    worker serves a whole FILE GROUP (tf.data FILE auto-shard), and the
+    round-robin client still sees every record exactly once per epoch."""
+
+    @pytest.fixture
+    def fileset(self, tmp_path):
+        rec = RecordFile([("x", (4,), np.float32), ("label", (), np.int32)])
+        rng = np.random.RandomState(0)
+        paths = []
+        for f in range(4):
+            arrays = {
+                "x": rng.randn(16, 4).astype(np.float32),
+                "label": (np.arange(16) + 100 * f).astype(np.int32),
+            }
+            p = str(tmp_path / f"idx-{f:05d}-of-00004.rec")
+            rec.write(p, arrays)
+            paths.append(p)
+        return paths, rec
+
+    def test_file_group_workers_cover_one_epoch(self, fileset):
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            DistributedDataServiceIterator,
+            register_worker,
+        )
+
+        paths, rec = fileset
+        disp = DataServiceDispatcher().start()
+        # 2 workers x 2-file groups: worker i serves files i, i+2.
+        workers = [
+            DataServiceServer(paths, rec, batch_size=8, shuffle=False,
+                              num_threads=1, shard_index=i, shard_count=2,
+                              policy="file").start()
+            for i in range(2)
+        ]
+        try:
+            for w in workers:
+                register_worker(disp.target, w.target)
+            it = DistributedDataServiceIterator(disp.target, rec, 8)
+            labels = []
+            for _ in range(8):  # 64 records / batch 8 = one epoch
+                labels.extend(next(it)["label"].tolist())
+            want = sorted(i + 100 * f for f in range(4) for i in range(16))
+            assert sorted(labels) == want
+            it.close()
+        finally:
+            for w in workers:
+                w.stop()
+            disp.stop()
+
+    def test_worker_cli_serves_file_group(self, fileset, tmp_path):
+        """The worker CLI resolves a fileset from --data_dir and serves its
+        file group (out-of-process, 2 processes x 2 files)."""
+        import socket as _socket
+        import time
+
+        from distributed_tensorflow_tpu.data.records import (
+            record_schema,
+            stage_synthetic_to_records,
+        )
+        from distributed_tensorflow_tpu.data.service import (
+            DataServiceIterator,
+        )
+        from distributed_tensorflow_tpu.models import get_workload
+
+        wl = get_workload("mnist", batch_size=16)
+        data_dir = tmp_path / "mnist_files"
+        stage_synthetic_to_records(
+            wl, str(data_dir / "mnist.rec"), 64, chunk=16, num_files=4)
+        procs = []
+        try:
+            for i in range(2):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "distributed_tensorflow_tpu.data.service",
+                     "--model=mnist", f"--data_dir={data_dir}",
+                     "--batch_size=8", f"--shard_index={i}",
+                     "--shard_count=2", "--auto_shard_policy=file"],
+                    env=dict(os.environ, JAX_PLATFORMS="cpu",
+                             PALLAS_AXON_POOL_IPS=""),
+                    cwd=REPO, stdout=subprocess.PIPE, text=True,
+                ))
+            targets = []
+            for pr in procs:
+                line = pr.stdout.readline()
+                assert "DATA_SERVICE_READY" in line, line
+                targets.append(line.split()[-1].strip())
+            schema = record_schema(wl)
+            for t in targets:
+                it = DataServiceIterator(t, schema, 8)
+                batch = next(it)
+                assert batch["image"].shape[0] == 8
+                it.close()
+        finally:
+            for pr in procs:
+                pr.terminate()
+                pr.wait(timeout=10)

@@ -280,3 +280,130 @@ class TestRecordTrainingPath:
         ))
         assert result["final_step"] == 10
         assert np.isfinite(result["loss"])
+
+
+class TestRecordSetLoader:
+    """Multi-file filesets with FILE/DATA/AUTO auto-shard (VERDICT r3 #4:
+    the reference's 1024-shard input layout)."""
+
+    @pytest.fixture
+    def fileset(self, tmp_path, record):
+        # 4 files x 16 records, label = global record id (file-major)
+        paths = []
+        rng = np.random.RandomState(1)
+        for f in range(4):
+            arrays = {
+                "image": rng.randn(16, 4, 4, 1).astype(np.float32),
+                "label": (np.arange(16) + 100 * f).astype(np.int32),
+            }
+            p = str(tmp_path / f"data-{f:05d}-of-00004.rec")
+            record.write(p, arrays)
+            paths.append(p)
+        return paths
+
+    def _labels_of_shard(self, record, paths, policy, s, n, draws=64):
+        from distributed_tensorflow_tpu.native import RecordSetLoader
+
+        ld = RecordSetLoader(
+            paths, record, batch_size=4, shuffle=False, policy=policy,
+            shard_index=s, shard_count=n, num_threads=1,
+        )
+        seen = set()
+        for _ in range(draws):
+            seen.update(int(x) for x in next(ld)["label"])
+        ld.close()
+        return seen, ld.policy
+
+    def test_file_policy_assigns_whole_files(self, record, fileset):
+        seen0, pol = self._labels_of_shard(record, fileset, "file", 0, 2)
+        seen1, _ = self._labels_of_shard(record, fileset, "file", 1, 2)
+        assert pol == "file"
+        # shard 0 -> files 0, 2; shard 1 -> files 1, 3 (whole files)
+        want0 = {i + 100 * f for f in (0, 2) for i in range(16)}
+        want1 = {i + 100 * f for f in (1, 3) for i in range(16)}
+        assert seen0 == want0
+        assert seen1 == want1
+
+    def test_data_policy_stripes_globally_disjoint_complete(
+            self, record, fileset):
+        seen0, pol = self._labels_of_shard(record, fileset, "data", 0, 2)
+        seen1, _ = self._labels_of_shard(record, fileset, "data", 1, 2)
+        assert pol == "data"
+        every = {i + 100 * f for f in range(4) for i in range(16)}
+        assert seen0 | seen1 == every
+        assert not (seen0 & seen1)
+        # exact tf.data DATA semantics: global record j -> shard j % 2,
+        # global order is file-major concatenation
+        glob = [i + 100 * f for f in range(4) for i in range(16)]
+        assert seen0 == set(glob[0::2])
+        assert seen1 == set(glob[1::2])
+
+    def test_auto_picks_file_then_falls_back_to_data(self, record, fileset):
+        _, pol = self._labels_of_shard(record, fileset, "auto", 0, 2)
+        assert pol == "file"  # 4 files >= 2 shards
+        _, pol = self._labels_of_shard(record, fileset, "auto", 0, 8)
+        assert pol == "data"  # 4 files < 8 shards
+
+    def test_file_policy_rejects_starved_shard(self, record, fileset):
+        from distributed_tensorflow_tpu.native import RecordSetLoader
+
+        with pytest.raises(FileNotFoundError):
+            RecordSetLoader(
+                fileset, record, batch_size=4, policy="file",
+                shard_index=5, shard_count=8, num_threads=1,
+            )
+
+    def test_stage_synthetic_writes_fileset_and_resolves(self, tmp_path):
+        from distributed_tensorflow_tpu.data.records import (
+            record_paths,
+            record_schema,
+            stage_synthetic_to_records,
+        )
+        from distributed_tensorflow_tpu.models import get_workload
+
+        wl = get_workload("mnist", batch_size=16)
+        base = str(tmp_path / "mnist.rec")
+        n = stage_synthetic_to_records(wl, base, 40, chunk=16, num_files=4)
+        assert n == 40
+        paths = record_paths(str(tmp_path), "mnist")
+        assert len(paths) == 4
+        schema = record_schema(wl)
+        total = 0
+        for p in paths:
+            payload = os.path.getsize(p) - 16
+            assert payload % schema.record_bytes == 0
+            total += payload // schema.record_bytes
+        assert total == 40
+
+    def test_train_end_to_end_from_fileset(self, tmp_path):
+        from distributed_tensorflow_tpu.data.records import (
+            stage_synthetic_to_records,
+        )
+        from distributed_tensorflow_tpu.models import get_workload
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        wl = get_workload("mnist", batch_size=16)
+        stage_synthetic_to_records(
+            wl, str(tmp_path / "mnist.rec"), 64, chunk=16, num_files=4)
+        res = run(TrainArgs(
+            model="mnist", steps=6, batch_size=16, log_every=2,
+            data_dir=str(tmp_path), auto_shard_policy="auto",
+        ))
+        assert res["final_step"] == 6
+        assert np.isfinite(res["loss"])
+
+    def test_record_paths_rejects_mixed_generations(self, tmp_path, record):
+        from distributed_tensorflow_tpu.data.records import record_paths
+
+        arrays = {
+            "image": np.zeros((4, 4, 4, 1), np.float32),
+            "label": np.arange(4, dtype=np.int32),
+        }
+        for name in ("d-00000-of-00004.rec", "d-00001-of-00004.rec",
+                     "d-00002-of-00004.rec", "d-00003-of-00004.rec",
+                     "d-00000-of-00002.rec"):  # stale older generation
+            record.write(str(tmp_path / name), arrays)
+        with pytest.raises(ValueError, match="mixes generations"):
+            record_paths(str(tmp_path), "d")
+        os.unlink(str(tmp_path / "d-00000-of-00002.rec"))
+        assert len(record_paths(str(tmp_path), "d")) == 4

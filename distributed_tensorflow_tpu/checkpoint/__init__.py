@@ -1,5 +1,18 @@
-"""Checkpointing (SURVEY.md §4.5, §6.4): orbax-backed save/restore."""
+"""Checkpointing (SURVEY.md §4.5, §6.4): orbax-backed save/restore, plus
+the one-way TF tensor-bundle reader for migrating reference checkpoints."""
 
 from distributed_tensorflow_tpu.checkpoint.manager import CheckpointManager
+from distributed_tensorflow_tpu.checkpoint.tf_compat import (
+    assign_into_tree,
+    load_tf_variables,
+    open_tf_checkpoint,
+    stack_layer_variables,
+)
 
-__all__ = ["CheckpointManager"]
+__all__ = [
+    "CheckpointManager",
+    "assign_into_tree",
+    "load_tf_variables",
+    "open_tf_checkpoint",
+    "stack_layer_variables",
+]

@@ -13,9 +13,14 @@ from distributed_tensorflow_tpu.ft.preemption import (
     PreemptionWatcher,
     TerminationConfig,
 )
-from distributed_tensorflow_tpu.ft.health import HealthChecker, HealthCheckHook
+from distributed_tensorflow_tpu.ft.health import (
+    BarrierUnavailableError,
+    HealthChecker,
+    HealthCheckHook,
+)
 
 __all__ = [
+    "BarrierUnavailableError",
     "HealthChecker",
     "HealthCheckHook",
     "PreemptionCheckpointHook",

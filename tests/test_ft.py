@@ -322,3 +322,45 @@ class TestKillAWorker:
         assert f"resumed from checkpoint step {steps[-1]}" in proc2.stdout, (
             proc2.stdout[-3000:]
         )
+
+
+class TestProbeIsolationWrapper:
+    """VERDICT r3 #9: a JAX upgrade that moves the private distributed
+    surface must RAISE at probe construction in multi-process runs, not
+    silently report healthy forever."""
+
+    def test_moved_internals_raise_loudly(self, monkeypatch):
+        import jax as _jax
+
+        from distributed_tensorflow_tpu.ft import BarrierUnavailableError
+        from distributed_tensorflow_tpu.ft.health import make_default_probe
+
+        monkeypatch.setattr(_jax, "process_count", lambda: 2)
+
+        class MovedState:  # no .client attribute -> AttributeError
+            pass
+
+        monkeypatch.setattr(_jax._src.distributed, "global_state",
+                            MovedState())
+        with pytest.raises(BarrierUnavailableError, match="moved"):
+            make_default_probe(1.0)
+
+    def test_uninitialized_client_raises(self, monkeypatch):
+        import jax as _jax
+
+        from distributed_tensorflow_tpu.ft import BarrierUnavailableError
+        from distributed_tensorflow_tpu.ft.health import make_default_probe
+
+        monkeypatch.setattr(_jax, "process_count", lambda: 2)
+
+        class State:
+            client = None
+
+        monkeypatch.setattr(_jax._src.distributed, "global_state", State())
+        with pytest.raises(BarrierUnavailableError, match="not initialized"):
+            make_default_probe(1.0)
+
+    def test_single_process_probe_is_trivially_healthy(self):
+        from distributed_tensorflow_tpu.ft.health import make_default_probe
+
+        assert make_default_probe(1.0)(0.1) is True

@@ -1,0 +1,219 @@
+"""One-way TF checkpoint interop (VERDICT r3 #8): TF writes a real
+tensor-bundle checkpoint with the INSTALLED tensorflow; this repo reads it
+back — through the TF-backed reader AND the pure-python bundle parser —
+and maps the variables into a params pytree, including stacking per-layer
+TF variables into the scanned (L, ...) layout."""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from distributed_tensorflow_tpu.checkpoint import (  # noqa: E402
+    assign_into_tree,
+    load_tf_variables,
+    stack_layer_variables,
+)
+from distributed_tensorflow_tpu.checkpoint.tf_compat import (  # noqa: E402
+    TFCheckpointError,
+    _PurePythonBundleReader,
+)
+
+
+@pytest.fixture
+def tf1_checkpoint(tmp_path):
+    """A TF1 Saver checkpoint (the reference's Saver path, saver.py:642)."""
+    rng = np.random.RandomState(0)
+    values = {
+        "dense/kernel": rng.randn(4, 8).astype(np.float32),
+        "dense/bias": rng.randn(8).astype(np.float32),
+        "embed/table": rng.randn(16, 4).astype(np.float32),
+        "global_step": np.int64(42),
+    }
+    g = tf.Graph()
+    with g.as_default():
+        for name, val in values.items():
+            tf.compat.v1.get_variable(name, initializer=val)
+        saver = tf.compat.v1.train.Saver()
+        with tf.compat.v1.Session(graph=g) as sess:
+            sess.run(tf.compat.v1.global_variables_initializer())
+            prefix = saver.save(sess, str(tmp_path / "model.ckpt"),
+                                write_meta_graph=False)
+    return prefix, values
+
+
+class TestBundleReaders:
+    @pytest.mark.parametrize("pure", [False, True],
+                             ids=["tf-backed", "pure-python"])
+    def test_reads_tf1_saver_checkpoint(self, tf1_checkpoint, pure):
+        prefix, values = tf1_checkpoint
+        got = load_tf_variables(prefix, force_pure_python=pure)
+        assert sorted(got) == sorted(values)
+        for name, want in values.items():
+            np.testing.assert_array_equal(got[name], np.asarray(want))
+
+    def test_readers_agree_bytewise(self, tf1_checkpoint):
+        prefix, _ = tf1_checkpoint
+        a = load_tf_variables(prefix, force_pure_python=True)
+        b = load_tf_variables(prefix, force_pure_python=False)
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+    def test_reads_tf2_object_checkpoint(self, tmp_path):
+        """TF2 tf.train.Checkpoint: names get the /.ATTRIBUTES suffix
+        stripped and the object-graph entry skipped."""
+        w = tf.Variable(np.arange(6, dtype=np.float32).reshape(2, 3),
+                        name="w")
+        ckpt = tf.train.Checkpoint(w=w)
+        prefix = ckpt.write(str(tmp_path / "obj.ckpt"))
+        for pure in (False, True):
+            got = load_tf_variables(prefix, force_pure_python=pure)
+            assert "w" in got, got.keys()  # suffix stripped to the obj path
+            np.testing.assert_array_equal(
+                got["w"], np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    def test_bf16_variables_decode(self, tmp_path):
+        v = tf.Variable(tf.constant([1.5, -2.25, 0.0], tf.bfloat16),
+                        name="b16")
+        ckpt = tf.train.Checkpoint(v=v)
+        prefix = ckpt.write(str(tmp_path / "b16.ckpt"))
+        got = load_tf_variables(prefix, force_pure_python=True)
+        np.testing.assert_array_equal(got["v"],
+                                      np.asarray([1.5, -2.25, 0.0],
+                                                 np.float32))
+
+    def test_non_bundle_file_rejected(self, tmp_path):
+        bad = tmp_path / "x.index"
+        bad.write_bytes(b"\x00" * 64)
+        with pytest.raises(TFCheckpointError, match="magic"):
+            _PurePythonBundleReader(str(tmp_path / "x"))
+
+
+class TestMappingIntoTree:
+    def test_assign_by_path_with_shape_check(self, tf1_checkpoint):
+        prefix, values = tf1_checkpoint
+        tf_vars = load_tf_variables(prefix, force_pure_python=True)
+        params = {
+            "dense": {"kernel": np.zeros((4, 8), np.float32),
+                      "bias": np.zeros((8,), np.float32)},
+            "embed": {"table": np.zeros((16, 4), np.float32)},
+        }
+        new = assign_into_tree(params, {
+            "dense/kernel": tf_vars["dense/kernel"],
+            "dense/bias": tf_vars["dense/bias"],
+            "embed/table": tf_vars["embed/table"],
+        })
+        np.testing.assert_array_equal(np.asarray(new["dense"]["kernel"]),
+                                      values["dense/kernel"])
+        np.testing.assert_array_equal(np.asarray(new["embed"]["table"]),
+                                      values["embed/table"])
+        # wrong shape is a loud error, not a silent broadcast
+        with pytest.raises(ValueError, match="shape"):
+            assign_into_tree(params, {
+                "dense/kernel": np.zeros((8, 4), np.float32)})
+        with pytest.raises(KeyError):
+            assign_into_tree(params, {"nope/kernel": np.zeros(1)})
+
+    def test_stack_per_layer_tf_vars_into_scanned_layout(self, tmp_path):
+        """The migration shape that matters for the transformer models:
+        TF checkpoints store layer_0..layer_N-1 separately; the scanned
+        modules want ONE (L, ...) parameter."""
+        L, d = 3, 4
+        rng = np.random.RandomState(7)
+        per_layer = {
+            f"encoder/layer_{i}/attention/kernel":
+                rng.randn(d, d).astype(np.float32)
+            for i in range(L)
+        }
+        g = tf.Graph()
+        with g.as_default():
+            for name, val in per_layer.items():
+                tf.compat.v1.get_variable(name, initializer=val)
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                prefix = saver.save(sess, str(tmp_path / "bert.ckpt"),
+                                    write_meta_graph=False)
+        tf_vars = load_tf_variables(prefix, force_pure_python=True)
+        stacked = stack_layer_variables(
+            tf_vars, "encoder/layer_{i}/attention/kernel", L)
+        assert stacked.shape == (L, d, d)
+        params = {"layers": {"attention": {
+            "kernel": np.zeros((L, d, d), np.float32)}}}
+        new = assign_into_tree(
+            params, {"layers/attention/kernel": stacked})
+        for i in range(L):
+            np.testing.assert_array_equal(
+                np.asarray(new["layers"]["attention"]["kernel"])[i],
+                per_layer[f"encoder/layer_{i}/attention/kernel"])
+
+    def test_restore_into_live_workload_params(self, tmp_path):
+        """End-to-end: TF writes the variables of the mnist CNN's shapes;
+        they land in the real workload's params tree and a forward pass
+        runs on them."""
+        import jax
+
+        from distributed_tensorflow_tpu.models import get_workload
+
+        wl = get_workload("mnist", batch_size=8)
+        variables = wl.module.init(jax.random.key(0), wl.init_batch["image"])
+        params = variables["params"]
+        flat = {}
+
+        def _walk(prefix, node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    _walk(f"{prefix}/{k}" if prefix else k, v)
+            else:
+                flat[prefix] = np.asarray(node)
+
+        _walk("", params)
+        rng = np.random.RandomState(3)
+        tf_values = {k: rng.randn(*v.shape).astype(np.float32) * 0.05
+                     for k, v in flat.items()}
+        g = tf.Graph()
+        with g.as_default():
+            for name, val in tf_values.items():
+                tf.compat.v1.get_variable(name, initializer=val)
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                prefix = saver.save(sess, str(tmp_path / "mnist.ckpt"),
+                                    write_meta_graph=False)
+        tf_vars = load_tf_variables(prefix, force_pure_python=True)
+        new_params = assign_into_tree(params, tf_vars)
+        logits = wl.module.apply({"params": new_params},
+                                 wl.init_batch["image"])
+        assert np.isfinite(np.asarray(logits)).all()
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(new_params)[0]),
+            tf_values[sorted(flat)[0]], rtol=1e-6)
+
+
+class TestPartitionedVariables:
+    """The reference's PS partitioner case (sharded_variable.py:84):
+    fixed_size_partitioner writes one logical variable as OrderedCode-keyed
+    slices; both readers reassemble the full tensor."""
+
+    @pytest.mark.parametrize("pure", [False, True],
+                             ids=["tf-backed", "pure-python"])
+    def test_reassembles_partitioned_variable(self, tmp_path, pure):
+        g = tf.Graph()
+        with g.as_default():
+            v = tf.compat.v1.get_variable(
+                "emb/table", shape=(16, 4), dtype=tf.float32,
+                partitioner=tf.compat.v1.fixed_size_partitioner(4),
+                initializer=tf.compat.v1.truncated_normal_initializer(
+                    seed=11))
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                full = sess.run(tf.convert_to_tensor(v))  # concatenated
+                prefix = saver.save(sess, str(tmp_path / "part.ckpt"),
+                                    write_meta_graph=False)
+        got = load_tf_variables(prefix, force_pure_python=pure)
+        assert "emb/table" in got
+        np.testing.assert_array_equal(got["emb/table"], full)

@@ -106,13 +106,16 @@ class EncoderLayer(nn.Module):
         if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
             # Long-context path: non-causal ring attention — sequence
             # sharded over the `context` axis, KV (and the key mask)
-            # rotating on the ICI ring.  Exact attention (online softmax);
-            # attention-prob dropout is unavailable here, residual dropout
-            # remains.
+            # rotating on the ICI ring.  Exact attention (online softmax)
+            # incl. attention-prob dropout (per-block dropout composes
+            # exactly under the lse combine).
+            drop = 0.0 if deterministic else cfg.dropout
             ctx = ring_attention(
                 q, k, v, mesh=self.mesh, causal=False,
                 chunk_size=cfg.ring_chunk_size or None,
                 kv_mask=input_mask,
+                dropout_rate=drop,
+                dropout_rng=self.make_rng("dropout") if drop > 0 else None,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
             # Attention-prob dropout runs IN-KERNEL (TPU PRNG, identical

@@ -122,11 +122,14 @@ class Block(nn.Module):
         if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
             # Long-context path: sequence sharded over the context axis, KV
             # rotating over the ICI ring (parallel.ring_attention).  Exact
-            # attention; attention-prob dropout is unavailable here (the
-            # full prob matrix never materializes), residual dropout remains.
+            # attention incl. attention-prob dropout (per-block dropout
+            # composes exactly under the lse combine).
+            drop = 0.0 if deterministic else cfg.dropout
             ctx = ring_attention(
                 q, k, v, mesh=self.mesh, causal=True,
                 chunk_size=cfg.ring_chunk_size or None,
+                dropout_rate=drop,
+                dropout_rng=self.make_rng("dropout") if drop > 0 else None,
             ).reshape(B, T, d)
         elif cfg.use_flash_attention:
             # Attention-prob dropout runs IN-KERNEL (TPU PRNG, identical

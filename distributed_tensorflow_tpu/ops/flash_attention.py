@@ -51,8 +51,9 @@ Design (round-4 schedule — FlashAttention-2 style grid streaming):
 - ``flash_attention_with_lse`` returns (out, lse) and is differentiable in
   BOTH outputs: ∂lse/∂s = P, so the lse cotangent folds into the backward
   kernels as dS = P ∘ (dP − Δ + g_lse) · scale.  This is the building block
-  ring attention consumes per key block.  (No dropout on this path: the
-  ring's cross-block combine assumes exact per-block softmax statistics.)
+  ring attention consumes per key block.  Dropout composes exactly with
+  the ring combine (l/lse always use undropped probabilities), so the
+  with_lse path supports it too — each block pair seeded distinctly.
 - Non-TPU platforms and awkward shapes fall back to the dense XLA path with
   identical numerics (f32 softmax); its backward is XLA autodiff.  The
   fallback's dropout uses ``jax.random`` — same distribution, different
@@ -123,9 +124,16 @@ def _dense(q, k, v, *, causal, scale, kv_mask=None, dropout_rate=0.0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
-def _dense_with_lse(q, k, v, *, causal, scale, kv_mask=None):
+def _dense_with_lse(q, k, v, *, causal, scale, kv_mask=None,
+                    dropout_rate=0.0, dropout_rng=None):
     """(out, lse) with plain XLA ops — the differentiable fallback for
-    ``flash_attention_with_lse`` off-TPU.  lse: (B, H, Tq) f32."""
+    ``flash_attention_with_lse`` off-TPU.  lse: (B, H, Tq) f32.
+
+    Dropout follows the softmax-dropout semantics of the kernel path: the
+    denominator (and lse) use UNDROPPED probabilities; only the PV
+    contraction sees the dropped/rescaled ones — which is exactly what
+    makes per-block dropout compose exactly under ring attention's lse
+    combine."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T = q.shape[1]
@@ -140,9 +148,10 @@ def _dense_with_lse(q, k, v, *, causal, scale, kv_mask=None):
     p = jnp.exp(scores - m_safe[..., None])
     l = jnp.sum(p, axis=-1)
     lse = m_safe + jnp.log(jnp.maximum(l, 1e-30))
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", (p / jnp.maximum(l, 1e-30)[..., None]).astype(v.dtype), v
-    )
+    probs = p / jnp.maximum(l, 1e-30)[..., None]
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        probs = probs * _dropout_mask(dropout_rng, probs.shape, dropout_rate)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out, lse
 
 
@@ -987,22 +996,24 @@ def _lse_to_bht(lse_lanes, B, H):
     return lse_lanes[:, :, 0].reshape(B, H, T)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_lse(q, k, v, kv_mask, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_lse(q, k, v, kv_mask, seed, causal, scale, dropout_rate):
     out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
-                              save_lse=True)
+                              save_lse=True, dropout_rate=dropout_rate,
+                              seed=seed)
     return out, _lse_to_bht(lse, q.shape[0], q.shape[2])
 
 
-def _flash_lse_fwd(q, k, v, kv_mask, causal, scale):
+def _flash_lse_fwd(q, k, v, kv_mask, seed, causal, scale, dropout_rate):
     out, lse = _flash_fwd_tpu(q, k, v, kv_mask, causal=causal, scale=scale,
-                              save_lse=True)
+                              save_lse=True, dropout_rate=dropout_rate,
+                              seed=seed)
     return ((out, _lse_to_bht(lse, q.shape[0], q.shape[2])),
-            (q, k, v, kv_mask, out, lse))
+            (q, k, v, kv_mask, seed, out, lse))
 
 
-def _flash_lse_bwd(causal, scale, res, cts):
-    q, k, v, kv_mask, o, lse = res
+def _flash_lse_bwd(causal, scale, dropout_rate, res, cts):
+    q, k, v, kv_mask, seed, o, lse = res
     g_out, g_lse = cts
     B, T, H, D = q.shape
     # (B, H, T) -> the kernels' (B·H, T, LANES) broadcast layout.
@@ -1010,8 +1021,9 @@ def _flash_lse_bwd(causal, scale, res, cts):
         g_lse.astype(jnp.float32).reshape(B * H, T, 1), (B * H, T, LANES)
     )
     dq, dk, dv = _flash_bwd_tpu(q, k, v, o, lse, g_out, kv_mask, g_lse_lanes,
-                                causal=causal, scale=scale)
-    return dq, dk, dv, None
+                                causal=causal, scale=scale,
+                                dropout_rate=dropout_rate, seed=seed)
+    return dq, dk, dv, None, None
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -1060,6 +1072,8 @@ def flash_attention_with_lse(
     causal: bool = True,
     scale: Optional[float] = None,
     kv_mask: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused attention returning (out, lse); differentiable in both.
 
@@ -1067,12 +1081,25 @@ def flash_attention_with_lse(
     scores.  The building block for ring attention's cross-block combine:
     out_total = Σ_blocks out_b · exp(lse_b − logsumexp_b lse_b) is exact.
     Rows with zero valid keys yield out = 0, lse = -1e30 (an exact no-op
-    under that combine).  No dropout on this path — the ring combine
-    assumes exact per-block softmax statistics.
+    under that combine).
+
+    Attention-prob dropout composes EXACTLY with that combine because the
+    softmax statistics (l, lse) always use UNDROPPED probabilities — only
+    the PV contraction sees the dropped/rescaled ones:
+    Σ_b exp(lse_b − lse_tot)·out_b = Σ_k P_k·M_k·v_k whether the sum is
+    one block or many.  Each block needs its OWN ``dropout_rng`` (the ring
+    folds in the global block-pair index) or masks would repeat per pair.
     """
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    if _supported(q, causal):
-        return _flash_lse(q, k, v, kv_mask, causal, scale)
+    seed = None
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        seed = _seed_operand(dropout_rng)
+    if _supported(q, causal, dropout_rate):
+        return _flash_lse(q, k, v, kv_mask, seed, causal, scale,
+                          float(dropout_rate))
     return _dense_with_lse(q, k, v, causal=causal, scale=scale,
-                           kv_mask=kv_mask)
+                           kv_mask=kv_mask, dropout_rate=dropout_rate,
+                           dropout_rng=dropout_rng)

@@ -42,19 +42,24 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops.flash_attention import (
     _dense,
+    _dropout_mask,
     _supported,
     flash_attention_with_lse,
 )
 
 
 def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale,
-                  kv_mask=None):
+                  kv_mask=None, dropout_rate=0.0, dropout_rng=None):
     """One (q-block × kv-block) partial attention with positional masking.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); kv_mask: optional (B, Tk) key
     validity.  Returns (scores-weighted values, running max, running denom)
     pieces in f32:
       partial: (B, Tq, H, D), m: (B, H, Tq), l: (B, H, Tq)
+
+    Dropout (softmax semantics, matching the flash kernels): l accumulates
+    UNDROPPED p; only the PV contraction sees the dropped/rescaled p —
+    which is what makes per-block dropout exact under the ring combine.
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -71,7 +76,10 @@ def _block_attend(q, k, v, *, q_offset, k_offset, causal, scale,
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(scores - m_safe[..., None])  # (B, H, Tq, Tk)
     l = jnp.sum(p, axis=-1)  # (B, H, Tq)
-    partial = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    p_v = p
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        p_v = p * _dropout_mask(dropout_rng, p.shape, dropout_rate)
+    partial = jnp.einsum("bhqk,bkhd->bqhd", p_v.astype(v.dtype), v)
     return partial.astype(jnp.float32), m_safe, l
 
 
@@ -90,7 +98,8 @@ def _combine(acc, l_acc, m_acc, partial, l_new, m_new):
 
 
 def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
-                          chunk, kv_mask=None):
+                          chunk, kv_mask=None, dropout_rate=0.0,
+                          dropout_rng=None):
     """``_block_attend`` with the kv block processed in ``chunk``-sized
     pieces under a scan: the (Tq, Tk) score tile never materializes —
     only (Tq, chunk) — bounding per-ring-step memory for long per-shard
@@ -110,9 +119,12 @@ def _block_attend_chunked(q, k, v, *, q_offset, k_offset, causal, scale,
         v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
         m_c = (None if kv_mask is None else
                lax.dynamic_slice_in_dim(kv_mask, i * chunk, chunk, axis=1))
+        rng_c = (None if dropout_rng is None
+                 else jax.random.fold_in(dropout_rng, i))
         partial, m_new, l_new = _block_attend(
             q, k_c, v_c, q_offset=q_offset, k_offset=k_offset + i * chunk,
             causal=causal, scale=scale, kv_mask=m_c,
+            dropout_rate=dropout_rate, dropout_rng=rng_c,
         )
         acc, l_acc, m_acc = _combine(acc, l_acc, m_acc, partial, l_new, m_new)
         return (acc, l_acc, m_acc), None
@@ -146,6 +158,8 @@ def ring_attention(
     chunk_size: Optional[int] = None,
     kv_mask: Optional[jax.Array] = None,
     use_flash: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention with the sequence dim sharded over ``axis``.
 
@@ -161,12 +175,25 @@ def ring_attention(
     the einsum path only: each arriving kv block is consumed in chunks of
     that many keys, so the biggest score tile is (T/N, chunk_size) — the
     flash path needs no chunking (its score tiles live in VMEM).
+
+    Attention-prob dropout (``dropout_rate``/``dropout_rng``) is EXACT
+    under the ring: every block's softmax statistics (l, lse) use
+    undropped probabilities, so per-block dropout + the lse combine equals
+    whole-sequence dropout (see flash_attention_with_lse).  The rng is
+    folded with this shard's batch-axis indices and each (q-shard,
+    kv-owner) pair, so no mask repeats anywhere in the global (T, T) grid.
     """
+    if dropout_rate > 0.0 and dropout_rng is None:
+        # Validate HERE, not per engine: the flash path raises, the dense/
+        # einsum paths would silently skip — the same call must behave the
+        # same on every platform.
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
     n = mesh.shape.get(axis, 1)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     if n == 1:
         return _dense_attention(q, k, v, causal=causal, scale=scale,
-                                kv_mask=kv_mask)
+                                kv_mask=kv_mask, dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng)
 
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     spec = P(batch, axis)
@@ -174,13 +201,22 @@ def ring_attention(
         # Per-shard shapes decide support (shard_map hands _local blocks).
         B, T, H, D = q.shape
         shard_q = jax.ShapeDtypeStruct((B, T // n, H, D), q.dtype)
-        use_flash = _supported(shard_q, causal)
+        use_flash = _supported(shard_q, causal, dropout_rate)
 
     def _local(q_blk, k_blk, v_blk, mask_blk):
         B, Tq, H, D = q_blk.shape
         my = lax.axis_index(axis)
         q_off = my * Tq
         perm = [(j, (j - 1) % n) for j in range(n)]
+        rng_local = None
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            # Distinct masks per batch shard AND per (my, owner) pair:
+            # fold the batch-axis indices here, the pair index per step.
+            rng_local = dropout_rng
+            for a in batch:
+                rng_local = jax.random.fold_in(
+                    rng_local, lax.axis_index(a))
+            rng_local = jax.random.fold_in(rng_local, my)
 
         def step_flash(carry, i):
             acc, lse_acc, k_cur, v_cur, m_cur = carry
@@ -188,12 +224,16 @@ def ring_attention(
             # shifts move blocks to lower indices each step).
             owner = (my + i) % n
 
+            rng_b = (None if rng_local is None
+                     else jax.random.fold_in(rng_local, owner))
+
             def attend(is_causal):
                 def f(op):
                     k_c, v_c, m_c = op
                     out_b, lse_b = flash_attention_with_lse(
                         q_blk, k_c, v_c, causal=is_causal, scale=scale,
-                        kv_mask=m_c,
+                        kv_mask=m_c, dropout_rate=dropout_rate,
+                        dropout_rng=rng_b,
                     )
                     return out_b.astype(jnp.float32), lse_b
                 return f
@@ -229,8 +269,11 @@ def ring_attention(
         def step_einsum(carry, i):
             acc, l_acc, m_acc, k_cur, v_cur, msk_cur = carry
             owner = (my + i) % n
+            rng_b = (None if rng_local is None
+                     else jax.random.fold_in(rng_local, owner))
             kw = dict(q_offset=q_off, k_offset=owner * Tq,
-                      causal=causal, scale=scale, kv_mask=msk_cur)
+                      causal=causal, scale=scale, kv_mask=msk_cur,
+                      dropout_rate=dropout_rate, dropout_rng=rng_b)
             if chunk_size is not None and chunk_size < k_cur.shape[1]:
                 partial, m_new, l_new = _block_attend_chunked(
                     q_blk, k_cur, v_cur, chunk=chunk_size, **kw)

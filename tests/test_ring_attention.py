@@ -188,3 +188,73 @@ class TestRingAttention:
                                 scale=1.0 / np.sqrt(q.shape[-1]))
         np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                    rtol=1e-6)
+
+
+class TestRingDropout:
+    """Attention-prob dropout under the ring (einsum block engine on CPU):
+    per-block dropout with undropped softmax statistics composes EXACTLY
+    under the lse combine, so the ring path no longer changes the recipe."""
+
+    def _ring(self, mesh_ctx, q, k, v, rate, rng, causal=True):
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        return np.asarray(ring_attention(
+            qs, ks, vs, mesh=mesh_ctx, causal=causal,
+            dropout_rate=rate, dropout_rng=rng))
+
+    def test_rate_zero_matches_dense_exactly(self, mesh_ctx):
+        q, k, v = make_qkv(seed=21)
+        got = self._ring(mesh_ctx, q, k, v, 0.0, None)
+        want = _dense_attention(q, k, v, causal=True,
+                                scale=1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(got, np.asarray(want), rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_deterministic_per_key_varies_across_keys(self, mesh_ctx):
+        q, k, v = make_qkv(seed=22)
+        a = self._ring(mesh_ctx, q, k, v, 0.3, jax.random.key(5))
+        b = self._ring(mesh_ctx, q, k, v, 0.3, jax.random.key(5))
+        c = self._ring(mesh_ctx, q, k, v, 0.3, jax.random.key(6))
+        base = self._ring(mesh_ctx, q, k, v, 0.0, None)
+        np.testing.assert_array_equal(a, b)
+        assert not np.allclose(a, c)
+        assert not np.allclose(a, base)
+
+    def test_dropout_is_unbiased_vs_undropped(self, mesh_ctx):
+        q, k, v = make_qkv(B=1, T=32, H=2, D=8, seed=23)
+        base = self._ring(mesh_ctx, q, k, v, 0.0, None, causal=False)
+        acc = np.zeros_like(base)
+        n = 48
+        for s in range(n):
+            acc += self._ring(mesh_ctx, q, k, v, 0.25,
+                              jax.random.key(200 + s), causal=False)
+        err = np.abs(acc / n - base).max() / (np.abs(base).max() + 1e-9)
+        assert err < 0.2, f"ring dropout mean deviates {err:.3f}"
+
+    def test_chunked_blocks_support_dropout(self, mesh_ctx):
+        q, k, v = make_qkv(seed=24)
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = np.asarray(ring_attention(
+            qs, ks, vs, mesh=mesh_ctx, causal=True, chunk_size=2,
+            dropout_rate=0.2, dropout_rng=jax.random.key(7)))
+        assert np.isfinite(out).all()
+        base = self._ring(mesh_ctx, q, k, v, 0.0, None)
+        assert not np.allclose(out, base)
+
+    def test_gradients_flow_through_dropout(self, mesh_ctx):
+        q, k, v = make_qkv(B=1, T=16, H=2, D=8, seed=25)
+        sh = NamedSharding(mesh_ctx, P(None, "context"))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        rng = jax.random.key(9)
+
+        def loss(q_, k_, v_):
+            out = ring_attention(q_, k_, v_, mesh=mesh_ctx, causal=True,
+                                 dropout_rate=0.2, dropout_rng=rng)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(qs, ks, vs)
+        for arr in g:
+            a = np.asarray(arr)
+            assert np.isfinite(a).all()
+            assert np.abs(a).max() > 0

@@ -11,8 +11,15 @@ from distributed_tensorflow_tpu.data.pipeline import (
     synthetic_recsys,
 )
 
+from distributed_tensorflow_tpu.data.tf_adapter import (
+    iterate_tf_dataset,
+    tf_dataset_data_fn,
+)
+
 __all__ = [
     "Batch",
+    "iterate_tf_dataset",
+    "tf_dataset_data_fn",
     "DevicePrefetchIterator",
     "make_global_batches",
     "per_host_batch_size",

@@ -1,0 +1,87 @@
+"""tf.data input adapter: run a reference input_fn unchanged.
+
+Role: the reference's training scripts build ``tf.data.Dataset`` pipelines
+(SURVEY.md §3.4 — input_lib consumed them).  Users migrating a workload
+arrive with an ``input_fn``/dataset they trust; this adapter lets them feed
+it to this framework's trainer directly while (or instead of) converting to
+the native record format:
+
+    ds = tf.data.TFRecordDataset(files).map(parse).shuffle(...).batch(bs)
+    workload.data_fn = tf_dataset_data_fn(lambda bs: ds)
+
+The adapter is HOST-side glue only — tensorflow never touches the device
+(the north star's "no GPU in the loop" applies to TF itself here: the
+dataset runs its C++ pipeline on CPU, numpy arrays cross into jax).  It is
+intentionally NOT the performance path: the native loader + data service
+own that (BASELINE.md); this is the porting on-ramp.
+
+tensorflow is imported lazily so the module (and the package) stays
+importable in TF-less deployments.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def iterate_tf_dataset(dataset, *, field_map: Optional[Dict[str, str]] = None,
+                       repeat: bool = True) -> Iterator[dict]:
+    """Yield numpy batch dicts from a tf.data.Dataset.
+
+    - Dict-element datasets pass through; tuple elements ``(features,
+      labels)`` with dict features are flattened to ``{**features,
+      "label": labels}`` (the estimator input_fn convention).
+    - ``field_map`` renames dataset keys to the workload's batch keys
+      (e.g. ``{"inputs": "image", "targets": "label"}``).
+    - ``repeat=True`` restarts the dataset at exhaustion (training streams
+      are infinite here; the dataset's own ``.repeat()`` also works).
+    """
+    while True:
+        count = 0
+        for elem in dataset.as_numpy_iterator():
+            count += 1
+            if isinstance(elem, tuple) and len(elem) == 2 \
+                    and isinstance(elem[0], dict):
+                features, labels = elem
+                batch = dict(features)
+                batch["label"] = labels
+            elif isinstance(elem, dict):
+                batch = dict(elem)
+            else:
+                raise ValueError(
+                    "tf.data adapter needs dict elements or (features-dict, "
+                    f"labels) tuples, got {type(elem)!r}; .map() the dataset "
+                    "into the workload's batch-dict shape first")
+            if field_map:
+                batch = {field_map.get(k, k): v for k, v in batch.items()}
+            yield {k: np.asarray(v) for k, v in batch.items()}
+        if not repeat:
+            return
+        if count == 0:
+            raise ValueError("tf.data adapter: dataset yielded no batches")
+        logger.info("tf.data adapter: dataset exhausted after %d batches; "
+                    "restarting (repeat=True)", count)
+
+
+def tf_dataset_data_fn(dataset_fn: Callable[[int], object], *,
+                       field_map: Optional[Dict[str, str]] = None,
+                       repeat: bool = True):
+    """A ``Workload.data_fn`` built from a reference-style input_fn.
+
+    ``dataset_fn(per_host_batch_size)`` returns a ``tf.data.Dataset`` whose
+    batch dimension matches the per-host batch size (the same contract the
+    reference's input_fns had per worker).  The returned data_fn plugs into
+    ``Workload.data_fn`` / ``train_lib`` unchanged.
+    """
+
+    def data_fn(per_host_batch_size: int) -> Iterator[dict]:
+        dataset = dataset_fn(per_host_batch_size)
+        return iterate_tf_dataset(dataset, field_map=field_map,
+                                  repeat=repeat)
+
+    return data_fn

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Migrate a reference (TensorFlow) workload into this framework, end to end.
+
+The two arrival artifacts a reference user brings are (1) a TF checkpoint
+(tensor-bundle ``.index``/``.data``) and (2) a ``tf.data`` input pipeline.
+This script runs the whole bridge:
+
+  1. writes a REAL TF1-Saver checkpoint with the MNIST CNN's variable
+     shapes (standing in for the user's trained model — in a real
+     migration this file already exists),
+  2. reads it back with ``checkpoint.load_tf_variables`` (pure-python
+     tensor-bundle parser — works without tensorflow installed; this demo
+     forces it to prove the point),
+  3. places the weights into the live workload's params with
+     ``assign_into_tree``,
+  4. trains onward feeding batches from a genuine ``tf.data.Dataset``
+     through ``data.tf_dataset_data_fn``.
+
+Run: python examples/migrate_from_tf.py  (needs tensorflow for steps 1/4)
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None):
+    import jax
+    import tensorflow as tf
+
+    from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.checkpoint import (
+        assign_into_tree,
+        load_tf_variables,
+    )
+    from distributed_tensorflow_tpu.data import (
+        DevicePrefetchIterator,
+        per_host_batch_size,
+        tf_dataset_data_fn,
+    )
+    from distributed_tensorflow_tpu.models import get_workload
+    from distributed_tensorflow_tpu.train_lib import build_state_and_step
+    from distributed_tensorflow_tpu.training import LoggingHook, TrainLoop
+
+    workload = get_workload("mnist", batch_size=32)
+
+    # --- 1. the "reference checkpoint": TF variables with the model's
+    # shapes (your trained Saver checkpoint in a real migration) ---------
+    variables = workload.module.init(
+        jax.random.key(0), workload.init_batch["image"])
+    flat = {}
+
+    def _walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                _walk(f"{prefix}/{k}" if prefix else k, v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    _walk("", variables["params"])
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory(prefix="tf_migrate_") as tmpdir:
+        g = tf.Graph()
+        with g.as_default():
+            for name, val in flat.items():
+                tf.compat.v1.get_variable(
+                    name, initializer=(rng.randn(*val.shape) * 0.05)
+                    .astype(np.float32))
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                prefix = saver.save(
+                    sess, os.path.join(tmpdir, "model.ckpt"),
+                    write_meta_graph=False)
+        print(f"[1] TF checkpoint written: {prefix}")
+
+        # --- 2+3. read the bundle (no-TF parser) and map into params ----
+        tf_vars = load_tf_variables(prefix, force_pure_python=True)
+    print(f"[2] read {len(tf_vars)} variables via the pure-python "
+          "tensor-bundle parser")
+    migrated = assign_into_tree(variables["params"], tf_vars)
+    print("[3] weights placed into the live params tree")
+
+    # --- 4. train onward from a real tf.data pipeline -------------------
+    def input_fn(batch_size):
+        images = rng.rand(512, 28, 28, 1).astype(np.float32)
+        labels = rng.randint(0, 10, size=512).astype(np.int32)
+        return tf.data.Dataset.from_tensor_slices(
+            {"image": images, "label": labels}
+        ).shuffle(512, seed=0).batch(batch_size, drop_remainder=True)
+
+    workload.data_fn = tf_dataset_data_fn(input_fn)
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig())
+    state, state_sh, train_step, batch_sh = build_state_and_step(
+        workload, mesh, total_steps=10)
+    state = state.replace(params=jax.tree.map(
+        lambda t, s: jax.device_put(np.asarray(t, np.float32), s.sharding)
+        if hasattr(s, "sharding") else t,
+        migrated, state.params))
+    data_iter = DevicePrefetchIterator(
+        workload.data_fn(per_host_batch_size(workload.batch_size)),
+        batch_sh[workload.example_key], prefetch=2)
+    loop = TrainLoop(train_step, state, data_iter,
+                     hooks=[LoggingHook(every_steps=5)],
+                     examples_per_step=workload.batch_size, metrics_every=5)
+    final = loop.run(10)
+    data_iter.close()
+    loss = loop.last_logged_metrics.get("loss")
+    print(f"MIGRATE_FROM_TF_DONE step={int(jax.device_get(final.step))} "
+          f"loss={loss}", flush=True)
+    return loss
+
+
+if __name__ == "__main__":
+    main()

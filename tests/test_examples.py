@@ -82,3 +82,18 @@ def test_tf1_ps_launcher_ps_and_worker(tmp_path):
     finally:
         ps.terminate()
         ps.wait(timeout=30)
+
+
+def test_migrate_from_tf_example(tmp_path):
+    """The migration showcase: real TF checkpoint -> pure-python bundle
+    reader -> params tree -> training fed by a real tf.data pipeline."""
+    pytest.importorskip("tensorflow")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "migrate_from_tf.py")],
+        env=_env(), cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "MIGRATE_FROM_TF_DONE" in out.stdout, out.stdout[-2000:]
+    line = [l for l in out.stdout.splitlines()
+            if "MIGRATE_FROM_TF_DONE" in l][0]
+    assert "step=10" in line

@@ -317,19 +317,51 @@ class MonitoredTrainingSession:
             h.begin(self._loop)
         return self
 
-    def run(self, train_op, *_unused_fetches):
-        """One ``sess.run(train_op)``: feed a batch, run the compiled step.
+    def run(self, train_op, *fetches):
+        """One ``sess.run(train_op, ...)``: feed a batch, run the step.
 
-        Returns the host metrics dict on ``metrics_every`` boundaries (None
-        otherwise — other steps stay fully async on device, the same
-        throttling as ``TrainLoop``, whose ``run_one_step`` this drives).
+        ``train_op`` may be the compiled step alone or a TF1-style fetch
+        list whose FIRST element is the step — the rest (and any extra
+        positional ``fetches``) are callables evaluated on the post-step
+        ``TrainState`` (e.g. ``global_step = lambda s: s.step``), so the
+        idiom ``_, step = sess.run([train_op, global_step])`` ports
+        directly.  With no extra fetches, returns the host metrics dict on
+        ``metrics_every`` boundaries (None otherwise — other steps stay
+        fully async on device, the same throttling as ``TrainLoop``, whose
+        ``run_one_step`` this drives); with fetches, returns the TF-shaped
+        list ``[metrics, *fetched_values]``.
         """
         if self._loop._stop:
             raise RuntimeError(
                 "run() called after should_stop() requested stop"
             )
+        extra = list(fetches)
+        if isinstance(train_op, (list, tuple)):
+            train_op, *rest = train_op
+            extra = list(rest) + extra
+        for f in extra:
+            if isinstance(f, dict):
+                raise TypeError(
+                    "sess.run(train_op, {...}) looks like a TF1 feed_dict "
+                    "— data flows through the session's data_iter here, "
+                    "not placeholders; fetches must be callables on the "
+                    "post-step TrainState"
+                )
+        before = self._step
         self._step = self._loop.run_one_step(self._step, train_step=train_op)
-        return self._loop.last_step_metrics
+        if not extra:
+            return self._loop.last_step_metrics
+        if self._step == before:
+            # Data exhausted: the step did NOT run (should_stop() is now
+            # set).  Return no fabricated fetch values — TF1 raised
+            # OutOfRangeError here; the graceful equivalent is Nones and
+            # a stopping loop.
+            return [None] * (1 + len(extra))
+        fetched = [
+            jax.device_get(f(self._loop.state)) if callable(f) else f
+            for f in extra
+        ]
+        return [self._loop.last_step_metrics, *fetched]
 
     def close(self) -> None:
         if self._closed:

@@ -34,8 +34,10 @@ def iterate_tf_dataset(dataset, *, field_map: Optional[Dict[str, str]] = None,
     """Yield numpy batch dicts from a tf.data.Dataset.
 
     - Dict-element datasets pass through; tuple elements ``(features,
-      labels)`` with dict features are flattened to ``{**features,
-      "label": labels}`` (the estimator input_fn convention).
+      labels)`` with dict features follow the estimator input_fn
+      convention: tensor labels land under ``"label"``, dict labels (the
+      multi-head convention) are merged by their own keys.  Key collisions
+      with the features are a loud error, not a silent overwrite.
     - ``field_map`` renames dataset keys to the workload's batch keys
       (e.g. ``{"inputs": "image", "targets": "label"}``).
     - ``repeat=True`` restarts the dataset at exhaustion (training streams
@@ -49,7 +51,15 @@ def iterate_tf_dataset(dataset, *, field_map: Optional[Dict[str, str]] = None,
                     and isinstance(elem[0], dict):
                 features, labels = elem
                 batch = dict(features)
-                batch["label"] = labels
+                label_fields = (labels if isinstance(labels, dict)
+                                else {"label": labels})
+                clash = batch.keys() & label_fields.keys()
+                if clash:
+                    raise ValueError(
+                        f"tf.data adapter: label field(s) {sorted(clash)} "
+                        "collide with feature keys; rename via field_map or "
+                        ".map() the dataset into one dict")
+                batch.update(label_fields)
             elif isinstance(elem, dict):
                 batch = dict(elem)
             else:
@@ -70,17 +80,33 @@ def iterate_tf_dataset(dataset, *, field_map: Optional[Dict[str, str]] = None,
 
 def tf_dataset_data_fn(dataset_fn: Callable[[int], object], *,
                        field_map: Optional[Dict[str, str]] = None,
-                       repeat: bool = True):
+                       repeat: bool = True,
+                       auto_shard: bool = True):
     """A ``Workload.data_fn`` built from a reference-style input_fn.
 
     ``dataset_fn(per_host_batch_size)`` returns a ``tf.data.Dataset`` whose
     batch dimension matches the per-host batch size (the same contract the
     reference's input_fns had per worker).  The returned data_fn plugs into
     ``Workload.data_fn`` / ``train_lib`` unchanged.
+
+    Multi-host: the pipeline contract is that each host yields only ITS
+    slice of the global batch — ``dataset_fn`` alone would build identical
+    datasets everywhere and silently duplicate data.  With ``auto_shard``
+    (default) the adapter applies ``dataset.shard(process_count,
+    process_index)`` per host (batch-level sharding — tf.data's DATA
+    policy at batch granularity).  Set ``auto_shard=False`` only when the
+    input_fn already shards itself (e.g. by ``jax.process_index()``).
     """
 
     def data_fn(per_host_batch_size: int) -> Iterator[dict]:
+        import jax
+
         dataset = dataset_fn(per_host_batch_size)
+        if auto_shard and jax.process_count() > 1:
+            dataset = dataset.shard(jax.process_count(), jax.process_index())
+            logger.info(
+                "tf.data adapter: auto-sharding dataset %d/%d by batch",
+                jax.process_index(), jax.process_count())
         return iterate_tf_dataset(dataset, field_map=field_map,
                                   repeat=repeat)
 

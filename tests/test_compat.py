@@ -213,3 +213,50 @@ class TestMonitoredTrainingSession:
 
         with pytest.raises(ValueError):
             MonitoredTrainingSession()
+
+    def test_tf1_fetch_list_idiom(self):
+        """`_, step = sess.run([train_op, global_step])` ports directly."""
+        from distributed_tensorflow_tpu.compat import (
+            MonitoredTrainingSession,
+            StopAtStepHook,
+        )
+
+        state, train_op, data = self._pieces()
+        global_step = lambda s: s.step  # the TF1 global_step tensor role
+        with MonitoredTrainingSession(
+            hooks=[StopAtStepHook(num_steps=3)],
+            state=state, data_iter=data, metrics_every=1,
+        ) as sess:
+            steps = []
+            while not sess.should_stop():
+                _, step = sess.run([train_op, global_step])
+                steps.append(int(step))
+        assert steps == [1, 2, 3]
+
+    def test_feed_dict_positional_rejected(self):
+        from distributed_tensorflow_tpu.compat import MonitoredTrainingSession
+
+        state, train_op, data = self._pieces()
+        with MonitoredTrainingSession(state=state, data_iter=data) as sess:
+            with pytest.raises(TypeError, match="feed_dict"):
+                sess.run(train_op, {"placeholder": 1})
+
+    def test_exhausted_iterator_yields_no_fabricated_fetches(self):
+        import itertools
+
+        from distributed_tensorflow_tpu.compat import MonitoredTrainingSession
+
+        state, train_op, _ = self._pieces()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        batch = {"x": x, "y": x @ np.ones((4, 1), np.float32)}
+        finite = iter([batch, batch])  # exactly 2 batches
+        global_step = lambda s: s.step
+        with MonitoredTrainingSession(state=state, data_iter=finite,
+                                      metrics_every=1) as sess:
+            results = []
+            while not sess.should_stop():
+                results.append(sess.run([train_op, global_step]))
+        # 2 real steps + the exhaustion call returning Nones
+        assert len(results) == 3
+        assert [int(r[1]) for r in results[:2]] == [1, 2]
+        assert results[2] == [None, None]

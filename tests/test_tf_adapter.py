@@ -86,3 +86,23 @@ class TestTfDataAdapter:
         final = loop.run(6)
         assert int(jax.device_get(final.step)) == 6
         it.close()
+
+    def test_dict_labels_merge_by_key(self):
+        def ds_fn(bs):
+            x = np.ones((16, 4), np.float32)
+            labels = {"y1": np.zeros((16,), np.int32),
+                      "y2": np.ones((16,), np.float32)}
+            return tf.data.Dataset.from_tensor_slices(
+                ({"x": x}, labels)).batch(bs)
+
+        b = next(tf_dataset_data_fn(ds_fn)(8))
+        assert sorted(b) == ["x", "y1", "y2"]
+
+    def test_label_feature_collision_is_loud(self):
+        def ds_fn(bs):
+            x = np.ones((16, 4), np.float32)
+            return tf.data.Dataset.from_tensor_slices(
+                ({"label": x}, np.zeros((16,), np.int32))).batch(bs)
+
+        with pytest.raises(ValueError, match="collide"):
+            next(tf_dataset_data_fn(ds_fn)(8))

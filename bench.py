@@ -70,17 +70,12 @@ def main(argv=None):
         )
         from distributed_tensorflow_tpu.data.records import (
             record_data_fn,
-            record_paths,
-            record_schema,
-            stage_synthetic_to_records,
+            resolve_or_stage,
         )
 
-        path = record_paths(flags.data_dir, wl.name)
-        want = record_schema(wl).file_size(flags.records)
-        if not (os.path.exists(path) and os.path.getsize(path) == want):
-            stage_synthetic_to_records(wl, path, flags.records)
+        paths = resolve_or_stage(flags.data_dir, wl, flags.records)
         data_iter = iter(DevicePrefetchIterator(
-            record_data_fn(path, wl, num_threads=2, prefetch=4)(host_bs),
+            record_data_fn(paths, wl, num_threads=2, prefetch=4)(host_bs),
             sh, prefetch=2,
         ))
     else:

@@ -347,6 +347,12 @@ class MonitoredTrainingSession:
                     "not placeholders; fetches must be callables on the "
                     "post-step TrainState"
                 )
+            if not callable(f):
+                raise TypeError(
+                    f"fetch {f!r} is not callable: TF1 tensor-name fetches "
+                    "have no graph to resolve against — pass a callable on "
+                    "the post-step TrainState (e.g. lambda s: s.step)"
+                )
         before = self._step
         self._step = self._loop.run_one_step(self._step, train_step=train_op)
         if not extra:

@@ -98,6 +98,43 @@ def record_paths(data_dir: str, workload_name: str) -> list:
     return shards
 
 
+def resolve_or_stage(data_dir: str, workload, num_examples: int) -> list:
+    """Resolve the workload's dataset in ``data_dir``, staging synthetic
+    records when absent (the bench/demo convenience path).
+
+    - No dataset: stage ``num_examples`` synthetic records into the single
+      ``{name}.rec`` and return it.
+    - Single file with the wrong record count: restage (the file is ours —
+      this path created it).
+    - Fileset with the wrong total record count: ERROR — a multi-file
+      dataset was staged deliberately; silently benchmarking the wrong
+      size (or clobbering it) would mislabel results.
+    """
+    from distributed_tensorflow_tpu.native.loader import RECORD_HEADER_BYTES
+
+    schema = record_schema(workload)
+    single = record_path(data_dir, workload.name)
+    try:
+        paths = record_paths(data_dir, workload.name)
+    except FileNotFoundError:
+        stage_synthetic_to_records(workload, single, num_examples)
+        return [single]
+    total = sum(
+        (os.path.getsize(p) - RECORD_HEADER_BYTES) // schema.record_bytes
+        for p in paths
+    )
+    if total != num_examples:
+        if paths == [single]:
+            stage_synthetic_to_records(workload, single, num_examples)
+        else:
+            raise ValueError(
+                f"{data_dir!r} holds a {len(paths)}-file {workload.name} "
+                f"fileset with {total} records, but {num_examples} were "
+                "requested; point --data_dir elsewhere or restage the "
+                "fileset explicitly")
+    return paths
+
+
 def fileset_paths(path: str, num_files: int) -> list:
     """Output paths for writing a dataset at ``path``: the single file
     itself, or (num_files > 1) the ``{name}-NNNNN-of-MMMMM.rec`` fileset

@@ -91,22 +91,47 @@ def tf_dataset_data_fn(dataset_fn: Callable[[int], object], *,
 
     Multi-host: the pipeline contract is that each host yields only ITS
     slice of the global batch — ``dataset_fn`` alone would build identical
-    datasets everywhere and silently duplicate data.  With ``auto_shard``
-    (default) the adapter applies ``dataset.shard(process_count,
-    process_index)`` per host (batch-level sharding — tf.data's DATA
-    policy at batch granularity).  Set ``auto_shard=False`` only when the
-    input_fn already shards itself (e.g. by ``jax.process_index()``).
+    datasets everywhere and silently duplicate data.  Two mechanisms, in
+    preference order:
+
+    1. If ``dataset_fn`` accepts ``(batch_size, shard_index,
+       shard_count)``, the adapter calls it with this host's coordinates
+       so the input_fn shards BEFORE its own shuffle — the exact tf.data
+       auto-shard semantics, correct for any pipeline.
+    2. Otherwise, with ``auto_shard`` (default), the adapter applies
+       ``dataset.shard(process_count, process_index)`` to the FINAL
+       dataset.  This is only disjoint when the pre-shard order is
+       identical across hosts — an UNSEEDED ``.shuffle()`` inside the
+       input_fn breaks that (each host shuffles differently, then keeps
+       every Nth batch of its own order → overlap).  The adapter cannot
+       see inside the pipeline, so it warns; seed the shuffle or use
+       form (1).
+
+    Set ``auto_shard=False`` only when the input_fn already shards itself
+    (e.g. by ``jax.process_index()``).
     """
+    import inspect
+
+    takes_shard_args = len(
+        inspect.signature(dataset_fn).parameters) >= 3
 
     def data_fn(per_host_batch_size: int) -> Iterator[dict]:
         import jax
 
-        dataset = dataset_fn(per_host_batch_size)
-        if auto_shard and jax.process_count() > 1:
-            dataset = dataset.shard(jax.process_count(), jax.process_index())
-            logger.info(
-                "tf.data adapter: auto-sharding dataset %d/%d by batch",
-                jax.process_index(), jax.process_count())
+        nproc, pidx = jax.process_count(), jax.process_index()
+        if takes_shard_args:
+            dataset = dataset_fn(per_host_batch_size, pidx, nproc)
+        else:
+            dataset = dataset_fn(per_host_batch_size)
+            if auto_shard and nproc > 1:
+                dataset = dataset.shard(nproc, pidx)
+                logger.warning(
+                    "tf.data adapter: sharding the FINAL dataset %d/%d — "
+                    "this is only disjoint across hosts if the input_fn's "
+                    "ordering is host-identical (seed any .shuffle()!); "
+                    "for exact pre-shuffle sharding accept (batch_size, "
+                    "shard_index, shard_count) in the input_fn",
+                    pidx, nproc)
         return iterate_tf_dataset(dataset, field_map=field_map,
                                   repeat=repeat)
 

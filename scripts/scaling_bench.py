@@ -73,17 +73,12 @@ def main():
             )
             from distributed_tensorflow_tpu.data.records import (
                 record_data_fn,
-                record_paths,
-                record_schema,
-                stage_synthetic_to_records,
+                resolve_or_stage,
             )
 
-            path = record_paths(args.data_dir, wl.name)
-            want = record_schema(wl).file_size(args.records)
-            if not (os.path.exists(path) and os.path.getsize(path) == want):
-                stage_synthetic_to_records(wl, path, args.records)
+            paths = resolve_or_stage(args.data_dir, wl, args.records)
             data_iter = iter(DevicePrefetchIterator(
-                record_data_fn(path, wl, num_threads=2, prefetch=4)(
+                record_data_fn(paths, wl, num_threads=2, prefetch=4)(
                     per_host_batch_size(wl.batch_size)),
                 bsh[wl.example_key], prefetch=2,
             ))

@@ -260,3 +260,11 @@ class TestMonitoredTrainingSession:
         assert len(results) == 3
         assert [int(r[1]) for r in results[:2]] == [1, 2]
         assert results[2] == [None, None]
+
+    def test_non_callable_fetch_rejected(self):
+        from distributed_tensorflow_tpu.compat import MonitoredTrainingSession
+
+        state, train_op, data = self._pieces()
+        with MonitoredTrainingSession(state=state, data_iter=data) as sess:
+            with pytest.raises(TypeError, match="not callable"):
+                sess.run([train_op, "global_step:0"])

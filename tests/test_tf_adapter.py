@@ -106,3 +106,14 @@ class TestTfDataAdapter:
 
         with pytest.raises(ValueError, match="collide"):
             next(tf_dataset_data_fn(ds_fn)(8))
+
+    def test_shard_aware_input_fn_gets_coordinates(self):
+        calls = []
+
+        def ds_fn(bs, shard_index, shard_count):
+            calls.append((bs, shard_index, shard_count))
+            return _image_dataset(bs)
+
+        b = next(tf_dataset_data_fn(ds_fn)(8))
+        assert b["image"].shape[0] == 8
+        assert calls == [(8, 0, 1)]  # single process: 0 of 1

@@ -18,6 +18,7 @@ report both and their gap.
 import argparse
 import json
 import os
+import statistics
 import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
@@ -30,6 +31,16 @@ def main(argv=None):
                     help="loader mode: records to stage (reused if present)")
     ap.add_argument("--data_dir", default="/tmp/dtt_bench_data",
                     help="loader mode: staging directory")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="timed windows; the reported value is the MEDIAN "
+                         "and the JSON carries min/max spread (one sample "
+                         "was not defensible evidence — VERDICT r4 weak #1)")
+    ap.add_argument("--fence", choices=("full", "loss"), default="full",
+                    help="diagnostic: 'loss' reproduces the r1-r3 fence "
+                         "(loss pull only — excludes the last step's "
+                         "optimizer update from the window); 'full' also "
+                         "pulls state.step (the honest fence, ADVICE r3). "
+                         "Exists to attribute cross-round deltas.")
     flags = ap.parse_args(argv)
     import jax
     import jax.numpy as jnp
@@ -59,8 +70,9 @@ def main(argv=None):
         image_size=image,
         stage_sizes=stages,
     )
+    windows = max(1, flags.windows)
     state, state_sh, train_step, batch_sh = build_state_and_step(
-        wl, mesh, precision=BF16, total_steps=warmup + iters,
+        wl, mesh, precision=BF16, total_steps=warmup + iters * windows,
     )
     sh = batch_sh[wl.example_key]
     host_bs = per_host_batch_size(wl.batch_size)
@@ -96,16 +108,24 @@ def main(argv=None):
     jax.device_get(m["loss"])
     jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state, m = train_step(state, next(data_iter),
-                              jax.random.fold_in(rng, warmup + i))
-    jax.device_get(m["loss"])
-    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
-    dt = time.perf_counter() - t0
+    # Median of N independently-fenced windows, with spread.  One timed
+    # sample per round made cross-round deltas indistinguishable from host
+    # noise (VERDICT r4 weak #1: 2343 vs 2209 with no error bars).
+    rates = []
+    step_idx = warmup
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = train_step(state, next(data_iter),
+                                  jax.random.fold_in(rng, step_idx))
+            step_idx += 1
+        jax.device_get(m["loss"])
+        if flags.fence == "full":
+            jax.device_get(state.step)  # fence covers the param update too
+        dt = time.perf_counter() - t0
+        rates.append(wl.batch_size * iters / dt / n_dev)
 
-    images_per_sec = wl.batch_size * iters / dt
-    per_chip = images_per_sec / n_dev
+    per_chip = statistics.median(rates)
 
     # Own-baseline ladder: first recorded real-TPU value is the 1.0 reference
     # point.  CPU smoke runs use a different (tiny) config, so they neither
@@ -148,6 +168,12 @@ def main(argv=None):
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
+        "spread": {
+            "n": len(rates),
+            "min": round(min(rates), 2),
+            "max": round(max(rates), 2),
+            "windows": [round(r, 2) for r in rates],
+        },
     }))
 
 

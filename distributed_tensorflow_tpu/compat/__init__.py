@@ -5,6 +5,12 @@ class documents what of the original's behavior is preserved, subsumed, or
 meaningless on TPU.  Nothing in the hot path lives here.
 """
 
+from distributed_tensorflow_tpu.compat.fit import (
+    Callback,
+    EarlyStopping,
+    History,
+    Model,
+)
 from distributed_tensorflow_tpu.compat.v1 import (
     CrossDeviceOps,
     HierarchicalCopyAllReduce,
@@ -18,8 +24,12 @@ from distributed_tensorflow_tpu.compat.v1 import (
 )
 
 __all__ = [
+    "Callback",
     "CrossDeviceOps",
+    "EarlyStopping",
     "HierarchicalCopyAllReduce",
+    "History",
+    "Model",
     "MonitoredTrainingSession",
     "NcclAllReduce",
     "ReductionToOneDevice",

@@ -19,6 +19,20 @@ program —
 With M microbatches over S stages the bubble fraction is (S-1)/(M+S-1) —
 choose M >= 4*S for >80% utilization.
 
+Two schedules:
+
+- ``pipeline_apply`` — GPipe fill-drain forward; backward is autodiff
+  through the scan, which stashes every tick's activations (O(M) live
+  microbatches).  Fine at pipe=2; the stash grows with M.
+- ``pipeline_value_and_grad(schedule="1f1b")`` — one-scan combined
+  forward+backward (non-interleaved 1F1B): each stage starts backward as
+  soon as its first microbatch returns, so at most S microbatch *inputs*
+  are ever stashed per stage (a ring buffer in the scan carry), and the
+  backward rematerialises the stage forward from the stashed input
+  (``jax.vjp`` inside the tick).  Memory: O(S) stash vs GPipe's O(M);
+  compute: one extra stage forward per microbatch (the remat) — the
+  standard deep-pipe trade.  Same bubble fraction as GPipe.
+
 Composition with the other mesh axes: the shard_map is *manual only over the
 pipe axis* (``axis_names={axis}``) — data/fsdp/tensor/context stay "auto",
 so GSPMD continues to shard the stage computation (TP matmuls, DP batch)
@@ -149,3 +163,182 @@ def pipeline_apply(
         check_vma=True,
     )(stacked_params, x.astype(jnp.float32) if boundary_f32 else x)
     return out.astype(in_dtype)
+
+
+def pipeline_value_and_grad(
+    stage_fn: StageFn,
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    stacked_params: PyTree,
+    x: jax.Array,
+    targets: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pipe",
+    schedule: str = "1f1b",
+) -> tuple:
+    """Loss and gradients through the pipeline under a chosen schedule.
+
+    ``loss_fn(y_mb, target_mb) -> scalar`` is the per-microbatch loss on the
+    last stage's output; the returned loss is its mean over the M
+    microbatches.  Returns ``(loss, grads, dx)`` where ``grads`` matches
+    ``stacked_params`` (stage dim sharded over ``axis``) and ``dx`` is the
+    cotangent w.r.t. ``x`` — the hand-off a pre-pipeline embedding backward
+    needs.
+
+    schedule="gpipe": differentiate through ``pipeline_apply`` (autodiff
+    stashes O(M) tick activations — the scan transpose).
+    schedule="1f1b": one combined scan of 2(M+S-1) half-ticks; tick parity
+    alternates forward/backward per stage, a depth-S ring buffer in the
+    carry stashes stage *inputs*, and each backward tick re-runs the stage
+    forward under ``jax.vjp`` (rematerialisation).  Losses and gradients are
+    the same math to floating-point tolerance (remat and per-microbatch
+    ``loss/M`` accumulation reorder the ops, so exact-equality golden tests
+    against "gpipe" will not hold) — only peak memory and the remat FLOPs
+    differ materially.
+    """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule: {schedule!r}")
+    S = mesh.shape[axis]
+    if schedule == "gpipe" or S == 1:
+        def total_loss(p, xx):
+            y = pipeline_apply(stage_fn, p, xx, mesh=mesh, axis=axis)
+            return jnp.mean(jax.vmap(loss_fn)(y, targets))
+
+        loss, (grads, dx) = jax.value_and_grad(total_loss, argnums=(0, 1))(
+            stacked_params, x
+        )
+        return loss, grads, dx
+
+    M = x.shape[0]
+    in_dtype = x.dtype
+    boundary_f32 = in_dtype in (jnp.bfloat16, jnp.float16)
+
+    def _local(params, x_loc, tgt_loc):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        idx = lax.axis_index(axis)
+        T = 2 * (M + S - 1)
+        mb_shape = x_loc.shape[1:]
+        vzero = (idx * 0).astype(jnp.float32)
+        vzero_c = vzero.astype(in_dtype)
+        # Pipe-VARYING zeros: both cond branches must produce identically
+        # varying outputs, and adding a varying zero is the collective-free
+        # promotion (see pipeline_apply).
+        mb_zero = jnp.zeros(mb_shape, in_dtype) + vzero_c
+        mb_zero_f32 = jnp.zeros(mb_shape, jnp.float32) + vzero
+        gzero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32) + vzero, params
+        )
+        perm_r = [(i, (i + 1) % S) for i in range(S)]
+        perm_l = [((i + 1) % S, i) for i in range(S)]
+
+        # Half-tick schedule (derivation in the module docstring's terms):
+        #   forward of microbatch m on stage s at tick  2m + s
+        #   backward of microbatch m on stage s at tick 2m + 2S - 1 - s
+        # so ticks alternate parity per stage ((t - s) even = forward), the
+        # cotangent a stage consumes at tick t was produced by stage s+1 at
+        # t-1, and slot m mod S in the stash is always freed (backward of
+        # m-S at tick 2m-1-s) before it is rewritten (forward of m at
+        # 2m+s).  Total ticks 2(M+S-1): bubble (S-1)/(M+S-1), same as GPipe.
+        def tick(carry, t):
+            fwd_recv, bwd_recv, stash, gacc, loss_acc, dx_buf = carry
+            is_fwd = ((t - idx) % 2) == 0
+            m_f = (t - idx) // 2
+            m_b = (t - (2 * S - 1 - idx)) // 2
+
+            def fwd_branch(ops):
+                fwd_recv, bwd_recv, stash = ops
+                valid = (m_f >= 0) & (m_f < M)
+                x_t = lax.dynamic_index_in_dim(
+                    x_loc, jnp.clip(m_f, 0, M - 1), 0, keepdims=False
+                ).astype(in_dtype)
+                inp = jnp.where(idx == 0, x_t, fwd_recv)
+                y = stage_fn(params, inp)
+                upd = lax.dynamic_update_index_in_dim(
+                    stash, inp, m_f % S, 0
+                )
+                stash = jnp.where(valid, upd, stash)
+                y_send = jnp.where(valid, y, jnp.zeros_like(y))
+                return (vzero, gzero, mb_zero, y_send, stash, mb_zero_f32)
+
+            def bwd_branch(ops):
+                fwd_recv, bwd_recv, stash = ops
+                valid = (m_b >= 0) & (m_b < M)
+                x_in = lax.dynamic_index_in_dim(
+                    stash, m_b % S, 0, keepdims=False
+                )
+                tgt = lax.dynamic_index_in_dim(
+                    tgt_loc, jnp.clip(m_b, 0, M - 1), 0, keepdims=False
+                )
+
+                def last_stage(_):
+                    l, pb = jax.vjp(
+                        lambda p, xi: loss_fn(stage_fn(p, xi), tgt),
+                        params, x_in,
+                    )
+                    gp, gx = pb(jnp.ones_like(l) / M)
+                    return l.astype(jnp.float32) / M, gp, gx
+
+                def mid_stage(_):
+                    _, pb = jax.vjp(stage_fn, params, x_in)
+                    gp, gx = pb(bwd_recv)
+                    return vzero, gp, gx
+
+                l, gp, gx = lax.cond(idx == S - 1, last_stage, mid_stage,
+                                     None)
+                l = jnp.where(valid, l, 0.0)
+                gp = jax.tree.map(
+                    lambda g: jnp.where(valid, g, 0.0).astype(jnp.float32),
+                    gp,
+                )
+                gx_send = jnp.where(valid, gx, jnp.zeros_like(gx))
+                return (l, gp, gx_send.astype(in_dtype), mb_zero, stash,
+                        gx_send.astype(jnp.float32))
+
+            (l, gp, gx_send, y_send, stash, gx_f32) = lax.cond(
+                is_fwd, fwd_branch, bwd_branch, (fwd_recv, bwd_recv, stash)
+            )
+            gacc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), gacc, gp
+            )
+            loss_acc = loss_acc + l
+            # stage 0's gx is d loss/d x for microbatch m_b — the embedding
+            # hand-off; other stages' gx rides the ring to the left.
+            take_dx = (idx == 0) & ((m_b >= 0) & (m_b < M)) & (~is_fwd)
+            dx_upd = lax.dynamic_update_index_in_dim(
+                dx_buf, gx_f32, jnp.clip(m_b, 0, M - 1), 0
+            )
+            dx_buf = jnp.where(take_dx, dx_upd, dx_buf)
+            fwd_next = lax.ppermute(y_send, axis, perm_r)
+            bwd_next = lax.ppermute(gx_send.astype(in_dtype), axis, perm_l)
+            return (fwd_next, bwd_next, stash, gacc, loss_acc, dx_buf), None
+
+        stash0 = jnp.zeros((S,) + mb_shape, in_dtype) + vzero_c
+        dx0 = jnp.zeros((M,) + mb_shape, jnp.float32) + vzero
+        carry0 = (mb_zero, mb_zero, stash0, gzero, vzero, dx0)
+        (_, _, _, gacc, loss_acc, dx_buf), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        # loss lives on the last stage, dx on stage 0 — psum replicates both
+        # (each is zero elsewhere, so the sum is exact).
+        loss = lax.psum(loss_acc, axis)
+        dx = lax.psum(
+            jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis
+        )
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype)[None], gacc, params
+        )
+        return loss, grads, dx
+
+    loss, grads, dx = jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=(P(), P(axis), P()),
+        axis_names={axis},
+        check_vma=True,
+    )(
+        stacked_params,
+        x.astype(jnp.float32) if boundary_f32 else x,
+        targets,
+    )
+    return loss, grads, dx.astype(in_dtype)

@@ -79,6 +79,8 @@ class TrainArgs:
     data_service: Optional[str] = None  # host:port of a data.service server
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
+    max_to_keep: int = 3
+    sync_checkpoint: bool = False  # block the step on checkpoint writes
     log_every: int = 50
     eval_every: int = 0  # 0 disables periodic evaluation
     eval_batches: int = 10
@@ -132,6 +134,12 @@ def parse_args(argv=None) -> TrainArgs:
                         "mutually exclusive with --data_dir")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=1000)
+    p.add_argument("--max_to_keep", type=int, default=3,
+                   help="retained checkpoints (tf.train.CheckpointManager "
+                        "max_to_keep, checkpoint_management.py:519)")
+    p.add_argument("--sync_checkpoint", action="store_true",
+                   help="block the training step on checkpoint writes "
+                        "(default: async orbax saves overlap training)")
     p.add_argument("--log_every", type=int, default=50)
     p.add_argument("--eval_every", type=int, default=0,
                    help="run evaluation every N steps (0 = off)")
@@ -416,8 +424,9 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     manager = None
     if args.checkpoint_dir:
         manager = CheckpointManager(
-            args.checkpoint_dir, max_to_keep=3,
+            args.checkpoint_dir, max_to_keep=args.max_to_keep,
             save_interval_steps=args.checkpoint_every,
+            async_save=not args.sync_checkpoint,
         )
         state = manager.restore_or_init(state)
         hooks.append(CheckpointHook(manager, every_steps=args.checkpoint_every))

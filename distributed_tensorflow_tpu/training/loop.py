@@ -202,6 +202,12 @@ class TrainLoop:
     def request_stop(self) -> None:
         self._stop = True
 
+    @property
+    def stopped(self) -> bool:
+        """Whether a stop was requested (hook, NaN, or data exhaustion) —
+        further ``run`` calls will make no progress."""
+        return self._stop
+
     def run_one_step(self, completed_steps: int, train_step=None) -> int:
         """One step: feed a batch, run the compiled step, drive hooks.
 

@@ -14,9 +14,14 @@ This script runs the whole bridge:
   3. places the weights into the live workload's params with
      ``assign_into_tree``,
   4. trains onward feeding batches from a genuine ``tf.data.Dataset``
-     through ``data.tf_dataset_data_fn``.
+     through ``data.tf_dataset_data_fn``,
+  5. re-runs the same training through the TF2 idiom — ``model.fit(dataset,
+     epochs=, callbacks=)`` via ``compat.fit.Model`` — so BOTH reference
+     training-loop styles (TF1 MonitoredTrainingSession in
+     examples/tf1_ps_launcher.py, TF2 Keras fit here) have a demonstrated
+     port with the loop call intact.
 
-Run: python examples/migrate_from_tf.py  (needs tensorflow for steps 1/4)
+Run: python examples/migrate_from_tf.py  (needs tensorflow for steps 1/4/5)
 """
 
 import os
@@ -110,6 +115,19 @@ def main(argv=None):
     final = loop.run(10)
     data_iter.close()
     loss = loop.last_logged_metrics.get("loss")
+    print(f"[4] custom-loop training done: step="
+          f"{int(jax.device_get(final.step))} loss={loss}")
+
+    # --- 5. the TF2 style: the fit call ports intact --------------------
+    from distributed_tensorflow_tpu.compat.fit import Model
+
+    dataset = input_fn(32)  # the user's dataset, as in their TF2 script
+    model = Model("mnist", batch_size=32)
+    model.compile(learning_rate=1e-3)
+    history = model.fit(dataset, epochs=2, steps_per_epoch=5)
+    fit_loss = history.history["loss"][-1]
+    print(f"[5] model.fit ported intact: epochs={history.epoch} "
+          f"loss={fit_loss:.4f}")
     print(f"MIGRATE_FROM_TF_DONE step={int(jax.device_get(final.step))} "
           f"loss={loss}", flush=True)
     return loss

@@ -116,7 +116,8 @@ class EarlyStopping(Callback):
             self.best, self.wait = value, 0
             return
         self.wait += 1
-        if self.wait > self.patience:
+        if self.wait >= self.patience:  # keras: >=, not > (patience=N
+            # means stop after N non-improving epochs)
             logger.info("EarlyStopping: no %s improvement for %d epochs; "
                         "stopping", self.monitor, self.wait)
             self.model.stop_training = True
@@ -319,8 +320,6 @@ class Model:
         bsh = self._batch_shardings[self.workload.example_key]
         host_iter = self._host_iter(x)
         data_iter = DevicePrefetchIterator(host_iter, bsh, prefetch=2)
-        val_iter = (self._device_batches(validation_data, for_eval=True)
-                    if validation_data is not None else None)
         loop = TrainLoop(
             self._train_step, self.state, data_iter,
             hooks=[bridge] + hook_cbs,
@@ -339,7 +338,13 @@ class Model:
                 bridge._dispatch("on_epoch_begin", epoch, {})
                 self.state = loop.run(steps_per_epoch)
                 logs = bridge.epoch_mean.report_and_reset()
-                if val_iter is not None:
+                if validation_data is not None:
+                    # fresh iterator per epoch (keras re-iterates
+                    # validation_data each epoch; a shared iterator would
+                    # exhaust a finite set after epoch 1 and silently stop
+                    # producing val_ metrics)
+                    val_iter = self._device_batches(
+                        validation_data, for_eval=True)
                     logs.update({
                         f"val_{k}": v for k, v in self._eval_loop(
                             val_iter, validation_steps).items()

@@ -355,6 +355,15 @@ def main(argv=None):
                    help="multi-file datasets: each worker serves whole "
                         "file groups (file), record stripes (data), or "
                         "file-when-enough-files (auto)")
+    p.add_argument("--journal", default=None,
+                   help="dispatcher: append-only registration journal; a "
+                        "restarted dispatcher replays it so late-joining "
+                        "consumers see the fleet (tf.data service work_dir "
+                        "role)")
+    p.add_argument("--heartbeat_s", type=float, default=5.0,
+                   help="worker: re-register with the dispatcher at this "
+                        "interval (0 disables) — covers journal-less "
+                        "dispatcher restarts")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, force=True)
@@ -363,7 +372,8 @@ def main(argv=None):
             DataServiceDispatcher,
         )
 
-        disp = DataServiceDispatcher(host=args.host, port=args.port).start()
+        disp = DataServiceDispatcher(host=args.host, port=args.port,
+                                     journal_path=args.journal).start()
         print(f"DATA_DISPATCHER_READY {disp.target}", flush=True)
         disp.join()
         return
@@ -389,9 +399,13 @@ def main(argv=None):
     if args.dispatcher:
         from distributed_tensorflow_tpu.data.dispatcher import (
             register_worker,
+            start_registration_heartbeat,
         )
 
         register_worker(args.dispatcher, server.target)
+        if args.heartbeat_s > 0:
+            start_registration_heartbeat(
+                args.dispatcher, server.target, interval_s=args.heartbeat_s)
     print(f"DATA_SERVICE_READY {server.target}", flush=True)
     server.join()
 

@@ -65,6 +65,10 @@ class WideDeep(nn.Module):
     mesh: Optional[Mesh] = None
     shard_axis: str = "data"
     dtype: Any = jnp.bfloat16
+    # Stored-row dtype of the embedding tables (bf16 halves gather bytes —
+    # the gather-bound roofline's one named headroom; optimizer keeps an
+    # f32 master + f32 moments via f32_master_of).
+    table_dtype: Any = jnp.float32
     # Replicate the wide tower's (V, 1) scalar table instead of row-sharding
     # it: lookups go fully local and backward syncs sparse grads with
     # psum_sparse (all_reduce_indexed_slices role) — the right trade for a
@@ -76,7 +80,8 @@ class WideDeep(nn.Module):
         dense, sparse = batch["dense"], batch["sparse"]
         # Deep tower
         emb = ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
-                           axis=self.shard_axis, name="deep_embed")(sparse)
+                           axis=self.shard_axis, name="deep_embed",
+                           param_dtype=self.table_dtype)(sparse)
         B, F, D = emb.shape
         deep_in = jnp.concatenate(
             [emb.reshape(B, F * D).astype(self.dtype),
@@ -86,6 +91,7 @@ class WideDeep(nn.Module):
         # Wide tower: linear over sparse (scalar table) + dense linear
         wide_emb = ShardedEmbed(self.vocab_size, 1, mesh=self.mesh,
                                 axis=self.shard_axis, name="wide_embed",
+                                param_dtype=self.table_dtype,
                                 replicated=self.replicate_wide)(sparse)
         wide_logit = (
             wide_emb.sum(axis=(1, 2), dtype=jnp.float32)[:, None]
@@ -111,13 +117,15 @@ class DLRM(nn.Module):
     mesh: Optional[Mesh] = None
     shard_axis: str = "data"
     dtype: Any = jnp.bfloat16
+    table_dtype: Any = jnp.float32  # see WideDeep.table_dtype
     feature_configs: Optional[Sequence[FeatureConfig]] = None
 
     def _embed(self, sparse: jax.Array) -> jax.Array:
         """(B, F) ids -> (B, F, D) embeddings, per the configured source."""
         if self.feature_configs is None:
             return ShardedEmbed(self.vocab_size, self.emb_dim, mesh=self.mesh,
-                                axis=self.shard_axis, name="deep_embed")(sparse)
+                                axis=self.shard_axis, name="deep_embed",
+                                param_dtype=self.table_dtype)(sparse)
         fcs = tuple(self.feature_configs)
         assert sparse.shape[-1] == len(fcs), (
             f"sparse has {sparse.shape[-1]} slots, config has {len(fcs)}"
@@ -160,6 +168,7 @@ def criteo_tables(
     *,
     vocab_sizes: Sequence[int] = (1_000_000, 100_000, 10_000),
     embedding_lr: float = 1e-2,
+    dtype: Any = None,  # None = f32 via TableConfig inherit default
 ) -> Tuple[FeatureConfig, ...]:
     """Default multi-table config: the ``num_sparse`` slots share 3 tables
     in Criteo-like cardinality tiers (a handful of huge tables, many small).
@@ -174,11 +183,12 @@ def criteo_tables(
     # independent of the default.
     tables = [
         TableConfig(vocab_sizes[0], emb_dim, name="table_large",
-                    combiner="sum", optimizer=optax.adagrad(embedding_lr)),
+                    combiner="sum", optimizer=optax.adagrad(embedding_lr),
+                    dtype=dtype),
         TableConfig(vocab_sizes[1], emb_dim, name="table_medium",
-                    combiner="sum"),
+                    combiner="sum", dtype=dtype),
         TableConfig(vocab_sizes[2], emb_dim, name="table_small",
-                    combiner="sum"),
+                    combiner="sum", dtype=dtype),
     ]
     return tuple(
         FeatureConfig(table=tables[i % len(tables)], name=f"slot_{i}")
@@ -217,8 +227,11 @@ def make_workload(
     shard_axis: str = "data",
     feature_configs: Optional[Sequence[FeatureConfig]] = None,
     replicate_wide_table: bool = False,
+    table_dtype: Any = "f32",
     **_unused,
 ) -> Workload:
+    td = (jnp.bfloat16 if table_dtype in ("bf16", jnp.bfloat16)
+          else jnp.float32)
     # Multi-table path: explicit config, or automatically when the mesh has
     # an expert axis to shard tables over (--expert N).
     multi_table = feature_configs is not None or (
@@ -231,7 +244,8 @@ def make_workload(
                 "multi-table embeddings (feature_configs / --expert>1) are "
                 f"wired into arch='dlrm', got arch={arch!r}"
             )
-        fcs = tuple(feature_configs or criteo_tables(num_sparse, emb_dim))
+        fcs = tuple(feature_configs
+                    or criteo_tables(num_sparse, emb_dim, dtype=td))
         vocab_size = max(fc.table.vocabulary_size for fc in fcs)
         shard_axis = "expert"
         module = DLRM(
@@ -247,14 +261,37 @@ def make_workload(
             )
     elif arch == "wide_deep":
         module = WideDeep(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
-                          shard_axis=shard_axis,
+                          shard_axis=shard_axis, table_dtype=td,
                           replicate_wide=replicate_wide_table)
     elif arch == "dlrm":
         module = DLRM(vocab_size=vocab_size, emb_dim=emb_dim, mesh=mesh,
-                      shard_axis=shard_axis,
+                      shard_axis=shard_axis, table_dtype=td,
                       bottom_layers=(512, 256, emb_dim))
     else:
         raise ValueError(f"unknown arch {arch!r}")
+    if not multi_table and td is not jnp.float32:
+        # bf16-stored tables under the default optimizer: wrap the table
+        # params (paths ending in .../embedding) in the f32-master branch so
+        # moments and accumulation stay f32 (see f32_master_of).
+        from distributed_tensorflow_tpu.parallel.embedding_config import (
+            f32_master_of,
+        )
+        from distributed_tensorflow_tpu.parallel.sharding import _path_str
+
+        def make_opt(schedule):
+            default = optax.adamw(schedule, weight_decay=1e-4)
+
+            def label_fn(params):
+                return jax.tree_util.tree_map_with_path(
+                    lambda p, _: ("table" if _path_str(p).endswith(
+                        "embedding") else "__default__"),
+                    params,
+                )
+
+            return optax.multi_transform(
+                {"__default__": default, "table": f32_master_of(default)},
+                label_fn,
+            )
     # Init batch must divide evenly over the shard axis AND the batch axes
     # (the lookup is a shard_map program with static per-shard shapes) —
     # lcm, not max: e.g. expert=4 with data=3 needs b0 % 3 == 0 too.

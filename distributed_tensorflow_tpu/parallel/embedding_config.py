@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,13 @@ class TableConfig:
     # 1319), so mechanically-ported configs keep their pooling semantics.
     combiner: str = "mean"
     optimizer: Optional[optax.GradientTransformation] = None
+    # Stored-row dtype (TPUEmbedding reduced-precision tables role).
+    # bfloat16 halves the gather/param bytes of the lookup — measured ~3%
+    # SLOWER at emb_dim 64 on v5e (rows below the HBM granule; BASELINE.md
+    # r5) but halves table param bytes — while the optimizer keeps an f32
+    # master copy + f32 moments (``f32_master_of``), so update math never
+    # accumulates in bf16.  None = inherit MultiTableEmbedding.param_dtype.
+    dtype: Any = None
 
     def __post_init__(self):
         if self.combiner not in ("sum", "mean"):
@@ -113,7 +120,8 @@ class MultiTableEmbedding(nn.Module):
                 mesh=self.mesh,
                 axis=self.axis,
                 batch_axes=tuple(self.batch_axes),
-                param_dtype=self.param_dtype,
+                param_dtype=t.dtype if t.dtype is not None
+                else self.param_dtype,
                 name=t.name,
             )
         self._tables = by_name
@@ -163,6 +171,47 @@ def multi_table_rules(
     )
 
 
+class MasterWeightState(NamedTuple):
+    inner: Any
+    master: Any  # f32 copy of the (low-precision) params
+
+
+def f32_master_of(
+    tx: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Master-weight wrapper for low-precision parameters.
+
+    Keeps an f32 copy of the params in the optimizer state; ``tx`` runs
+    entirely in f32 (grads are upcast, moments are f32 because they are
+    initialized from the f32 master); the emitted update is
+    ``(master_new - params)`` cast to the param dtype, so the stored
+    low-precision params track the f32 master to within one rounding.  This
+    is the same master-weight pattern the bf16 training policy uses for
+    dense params (training/step), applied at the optimizer layer so
+    bf16-stored embedding TABLES (gather-bandwidth halving) never
+    accumulate updates in bf16.  The master shards with the params: its
+    state path ends in the same ``.../embedding`` the table rules match.
+    """
+
+    def init(params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        return MasterWeightState(tx.init(master), master)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("f32_master_of requires params in update()")
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        upd32, inner = tx.update(g32, state.inner, state.master)
+        master = optax.apply_updates(state.master, upd32)
+        emitted = jax.tree.map(
+            lambda m, p: (m - p.astype(jnp.float32)).astype(p.dtype),
+            master, params,
+        )
+        return emitted, MasterWeightState(inner, master)
+
+    return optax.GradientTransformation(init, update)
+
+
 def multi_table_optimizer(
     feature_configs: Sequence[FeatureConfig],
     default_tx: optax.GradientTransformation,
@@ -171,10 +220,21 @@ def multi_table_optimizer(
 
     Tables with ``optimizer`` set get their own optax branch; everything
     else (dense layers, tables without an override) uses ``default_tx``.
+    Low-precision tables (``dtype=bfloat16``) get their branch wrapped in
+    ``f32_master_of`` — with or without a per-table optimizer.
     """
-    tables = [t for t in unique_tables(feature_configs) if t.optimizer is not None]
+    def needs_branch(t):
+        return t.optimizer is not None or t.dtype not in (None, jnp.float32)
+
+    def branch(t):
+        tx = t.optimizer if t.optimizer is not None else default_tx
+        if t.dtype not in (None, jnp.float32):
+            tx = f32_master_of(tx)
+        return tx
+
+    tables = [t for t in unique_tables(feature_configs) if needs_branch(t)]
     transforms = {"__default__": default_tx}
-    transforms.update({t.name: t.optimizer for t in tables})
+    transforms.update({t.name: branch(t) for t in tables})
     patterns = [(t.name, re.compile(rf"(^|/){t.name}/embedding$")) for t in tables]
 
     def label_fn(params):

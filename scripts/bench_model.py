@@ -41,6 +41,12 @@ def main(argv=None):
                          "per-phase auto)")
     ap.add_argument("--ce_chunk", type=int, default=None,
                     help="gpt2: chunked cross-entropy length (0 = full)")
+    ap.add_argument("--table_dtype", choices=("f32", "bf16"), default="f32",
+                    help="wide_deep: stored embedding-row dtype (bf16 "
+                         "halves gather bytes; f32 master in opt state)")
+    ap.add_argument("--emb_dim", type=int, default=None,
+                    help="wide_deep: embedding row width (row bytes = "
+                         "emb_dim * itemsize vs the ~512B HBM granule)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--windows", type=int, default=3,
@@ -64,6 +70,10 @@ def main(argv=None):
         kw["arch"] = args.arch
     if args.ce_chunk is not None:
         kw["ce_chunk"] = args.ce_chunk
+    if args.table_dtype != "f32":
+        kw["table_dtype"] = args.table_dtype
+    if args.emb_dim is not None:
+        kw["emb_dim"] = args.emb_dim
     wl = get_workload(
         args.model,
         batch_size=args.batch_size * n_dev,
@@ -106,6 +116,7 @@ def main(argv=None):
         "batch_per_chip": args.batch_size,
         "flash": ("off" if args.no_flash_attention else
                   "on" if args.flash_attention else "workload-default"),
+        "table_dtype": args.table_dtype,
         "grad_accum_steps": args.grad_accum_steps,
         "examples_per_sec_per_chip": round(ex_per_sec / n_dev, 1),
         "tokens_per_sec_per_chip": round(ex_per_sec * args.seq_len / n_dev),

@@ -304,6 +304,16 @@ class Model:
         """
         self._build(total_steps=epochs * steps_per_epoch, for_training=True)
         self.stop_training = False
+        if (validation_data is not None and not callable(validation_data)
+                and not hasattr(validation_data, "as_numpy_iterator")
+                and iter(validation_data) is validation_data):
+            # A one-shot iterator/generator would exhaust after epoch 1 and
+            # val_ metrics would silently vanish (keras re-iterates
+            # validation_data each epoch) — refuse loudly instead.
+            raise ValueError(
+                "validation_data must be re-iterable per epoch (a list, "
+                "tf.data.Dataset, or data_fn callable) — got a one-shot "
+                "iterator/generator")
         keras_cbs = [cb for cb in callbacks if not isinstance(cb, Hook)]
         hook_cbs = [cb for cb in callbacks if isinstance(cb, Hook)]
         for cb in keras_cbs:

@@ -63,6 +63,13 @@ class Workload:
     # the pair keeps the staging mechanism self-contained per workload.
     to_record: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
     from_record: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
+    # Per-step device-side augmentation (the reference ResNet recipe's
+    # random crop + flip — the tf.data map stage of its ImageNet input_fn,
+    # moved on-device): applied INSIDE the compiled train step to the raw
+    # (possibly still uint8-staged) batch BEFORE from_record, with fresh
+    # randomness each step from the step rng.  Zero host cost; never
+    # applied at eval.  Signature: (batch_dict, rng) -> batch_dict.
+    augment_fn: Optional[Callable[[Dict[str, Any], Any], Dict[str, Any]]] = None
 
 
 _REGISTRY = {

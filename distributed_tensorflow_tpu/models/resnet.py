@@ -65,6 +65,49 @@ def dequantize_images(batch):
     return out
 
 
+def augment_images(batch, rng, *, pad: int = None):
+    """Per-step train augmentation (Workload.augment_fn): random horizontal
+    flip + random pad-crop, ON DEVICE inside the compiled step.
+
+    This is the random_crop/random_flip_left_right tf.data map stage of the
+    reference's ImageNet input_fn (consumed via input_lib — part of the
+    ResNet-50 *recipe*, not a nicety) relocated to where it is cheap on
+    TPU: it runs on the raw batch BEFORE ``from_record``, so uint8-staged
+    images are flipped/cropped as uint8 (the cheap bytes stay cheap) and
+    the host path still moves fixed-size pre-staged tensors.  Fresh
+    randomness per step comes from the step rng; eval never calls this
+    (train_lib._wrap_from_record wires it train-only).
+
+    Implementation note (measured on v5e-1, batch 256x224^2 uint8): the
+    textbook composition — bernoulli ``where`` flip, ``jnp.pad(edge)``,
+    per-image ``vmap(dynamic_slice)`` — costs 170-316 ms/step (the vmapped
+    slice lowers to a pathological gather and the fused uint8 chain
+    explodes), which HALVED end-to-end throughput.  Folding flip and edge
+    padding INTO the gather indices (flip = reversed column index,
+    edge-pad = index clamp) leaves two plain ``take_along_axis`` gathers
+    and costs 5.8 ms/step (~5%).  Same math, 30-50x cheaper.
+    """
+    img = batch["image"]
+    B, H, W, C = img.shape
+    if pad is None:
+        # Shift amplitude scales with resolution (4 px at 224 — the
+        # standard ImageNet jitter); a fixed 4 px on a 32 px test image
+        # would displace 12% of the frame and wreck tiny-image convergence.
+        pad = max(1, round(H / 56))
+    r_flip, r_crop = jax.random.split(jax.random.fold_in(rng, 0x0A76))
+    flip = jax.random.bernoulli(r_flip, 0.5, (B,))
+    offsets = jax.random.randint(r_crop, (B, 2), -pad, pad + 1)
+    rows = jnp.clip(offsets[:, 0:1] + jnp.arange(H)[None, :], 0, H - 1)
+    cols = jnp.arange(W)[None, :]
+    cols = jnp.where(flip[:, None], W - 1 - cols, cols)
+    cols = jnp.clip(offsets[:, 1:2] + cols, 0, W - 1)
+    img = jnp.take_along_axis(img, rows[:, :, None, None], axis=1)
+    img = jnp.take_along_axis(img, cols[:, None, :, None], axis=2)
+    out = dict(batch)
+    out["image"] = img
+    return out
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
 
@@ -185,6 +228,9 @@ def make_workload(
     image_size: int = 224,
     stage_sizes: Sequence[int] = (3, 4, 6, 3),
     learning_rate: float = 0.1,  # scaled by batch/256 in the classic recipe
+    augment: bool = True,  # per-step device-side crop+flip (the recipe);
+    # False for short-horizon convergence tests where per-step view
+    # variance swamps an 8-step loss-decrease assertion
     **_unused,
 ) -> Workload:
     module = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes)
@@ -222,4 +268,5 @@ def make_workload(
         ),
         to_record=quantize_images,
         from_record=dequantize_images,
+        augment_fn=augment_images if augment else None,
     )

@@ -43,7 +43,7 @@ appearing in model code.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 PyTree = Any
 # stage_fn(stage_params, x) -> y ; same x/y shape for all stages
 StageFn = Callable[[PyTree, jax.Array], jax.Array]
+
+
+class PipelineVJP(NamedTuple):
+    """Result of ``pipeline_value_and_grad``.
+
+    loss: scalar mean loss over microbatches (replicated).
+    grads: cotangent of ``stacked_params`` (stage dim sharded over the pipe
+        axis).
+    dx: cotangent of ``x`` — feed to the pre-pipeline (embedding) backward.
+    tail_grads: cotangent of ``tail_params`` (replicated), or None when no
+        trainable tail was given.
+    """
+
+    loss: jax.Array
+    grads: PyTree
+    dx: jax.Array
+    tail_grads: Optional[PyTree]
 
 
 def stack_stage_params(per_stage_params: list) -> PyTree:
@@ -167,7 +184,7 @@ def pipeline_apply(
 
 def pipeline_value_and_grad(
     stage_fn: StageFn,
-    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    loss_fn: Optional[Callable[[jax.Array, Any], jax.Array]],
     stacked_params: PyTree,
     x: jax.Array,
     targets: jax.Array,
@@ -175,15 +192,26 @@ def pipeline_value_and_grad(
     mesh: Mesh,
     axis: str = "pipe",
     schedule: str = "1f1b",
-) -> tuple:
+    tail_fn: Optional[Callable[[PyTree, jax.Array, Any], jax.Array]] = None,
+    tail_params: PyTree = None,
+) -> "PipelineVJP":
     """Loss and gradients through the pipeline under a chosen schedule.
 
     ``loss_fn(y_mb, target_mb) -> scalar`` is the per-microbatch loss on the
     last stage's output; the returned loss is its mean over the M
-    microbatches.  Returns ``(loss, grads, dx)`` where ``grads`` matches
-    ``stacked_params`` (stage dim sharded over ``axis``) and ``dx`` is the
-    cotangent w.r.t. ``x`` — the hand-off a pre-pipeline embedding backward
-    needs.
+    microbatches.  For a model with a trainable head (final LN + LM head),
+    pass ``tail_fn(tail_params, y_mb, target_mb) -> scalar`` instead
+    (``loss_fn`` is then unused): the tail runs on the LAST stage, its
+    gradients come back replicated in ``tail_grads``.  Composition recipe
+    for a full model (embedding -> stages -> head) WITHOUT autodiff through
+    the schedule:
+
+        x, emb_vjp = jax.vjp(embed_fn, emb_params, tokens)
+        r = pipeline_value_and_grad(stage_fn, None, staged, x, targets,
+                                    mesh=mesh, tail_fn=head_loss,
+                                    tail_params=head_params)
+        d_emb, _ = emb_vjp(r.dx)
+        # weight tying: total dE = d_emb[E] + r.tail_grads[E]
 
     schedule="gpipe": differentiate through ``pipeline_apply`` (autodiff
     stashes O(M) tick activations — the scan transpose).
@@ -198,22 +226,32 @@ def pipeline_value_and_grad(
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule: {schedule!r}")
+    if tail_fn is None and loss_fn is None:
+        raise ValueError("need loss_fn or tail_fn")
+    has_tail = tail_fn is not None
+    if not has_tail:
+        tail_params = ()  # empty pytree: zero-cost to thread through
     S = mesh.shape[axis]
-    if schedule == "gpipe" or S == 1:
-        def total_loss(p, xx):
-            y = pipeline_apply(stage_fn, p, xx, mesh=mesh, axis=axis)
-            return jnp.mean(jax.vmap(loss_fn)(y, targets))
 
-        loss, (grads, dx) = jax.value_and_grad(total_loss, argnums=(0, 1))(
-            stacked_params, x
-        )
-        return loss, grads, dx
+    def mb_loss(tp, y, tgt):
+        return tail_fn(tp, y, tgt) if has_tail else loss_fn(y, tgt)
+
+    if schedule == "gpipe" or S == 1:
+        def total_loss(p, xx, tp):
+            y = pipeline_apply(stage_fn, p, xx, mesh=mesh, axis=axis)
+            per = jax.vmap(lambda ym, tm: mb_loss(tp, ym, tm))(y, targets)
+            return jnp.mean(per)
+
+        loss, (grads, dx, gt) = jax.value_and_grad(
+            total_loss, argnums=(0, 1, 2)
+        )(stacked_params, x, tail_params)
+        return PipelineVJP(loss, grads, dx, gt if has_tail else None)
 
     M = x.shape[0]
     in_dtype = x.dtype
     boundary_f32 = in_dtype in (jnp.bfloat16, jnp.float16)
 
-    def _local(params, x_loc, tgt_loc):
+    def _local(params, x_loc, tgt_loc, tail_p):
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
         idx = lax.axis_index(axis)
         T = 2 * (M + S - 1)
@@ -228,6 +266,14 @@ def pipeline_value_and_grad(
         gzero = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32) + vzero, params
         )
+        # Promote tail params to pipe-varying (zero-add, collective-free):
+        # their vjp cotangent must type identically in both cond branches.
+        tail_p = jax.tree.map(
+            lambda p: p + vzero.astype(jnp.asarray(p).dtype), tail_p
+        )
+        gtail_zero = jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32) + vzero, tail_p
+        )
         perm_r = [(i, (i + 1) % S) for i in range(S)]
         perm_l = [((i + 1) % S, i) for i in range(S)]
 
@@ -240,7 +286,8 @@ def pipeline_value_and_grad(
         # m-S at tick 2m-1-s) before it is rewritten (forward of m at
         # 2m+s).  Total ticks 2(M+S-1): bubble (S-1)/(M+S-1), same as GPipe.
         def tick(carry, t):
-            fwd_recv, bwd_recv, stash, gacc, loss_acc, dx_buf = carry
+            (fwd_recv, bwd_recv, stash, gacc, gtacc, loss_acc,
+             dx_buf) = carry
             is_fwd = ((t - idx) % 2) == 0
             m_f = (t - idx) // 2
             m_b = (t - (2 * S - 1 - idx)) // 2
@@ -258,7 +305,8 @@ def pipeline_value_and_grad(
                 )
                 stash = jnp.where(valid, upd, stash)
                 y_send = jnp.where(valid, y, jnp.zeros_like(y))
-                return (vzero, gzero, mb_zero, y_send, stash, mb_zero_f32)
+                return (vzero, gzero, gtail_zero, mb_zero, y_send, stash,
+                        mb_zero_f32)
 
             def bwd_branch(ops):
                 fwd_recv, bwd_recv, stash = ops
@@ -272,33 +320,41 @@ def pipeline_value_and_grad(
 
                 def last_stage(_):
                     l, pb = jax.vjp(
-                        lambda p, xi: loss_fn(stage_fn(p, xi), tgt),
-                        params, x_in,
+                        lambda p, xi, tp: mb_loss(tp, stage_fn(p, xi), tgt),
+                        params, x_in, tail_p,
                     )
-                    gp, gx = pb(jnp.ones_like(l) / M)
-                    return l.astype(jnp.float32) / M, gp, gx
+                    gp, gx, gt = pb(jnp.ones_like(l) / M)
+                    gt = jax.tree.map(lambda g: g.astype(jnp.float32), gt)
+                    return l.astype(jnp.float32) / M, gp, gt, gx
 
                 def mid_stage(_):
                     _, pb = jax.vjp(stage_fn, params, x_in)
                     gp, gx = pb(bwd_recv)
-                    return vzero, gp, gx
+                    return vzero, gp, gtail_zero, gx
 
-                l, gp, gx = lax.cond(idx == S - 1, last_stage, mid_stage,
-                                     None)
+                l, gp, gt, gx = lax.cond(idx == S - 1, last_stage,
+                                         mid_stage, None)
                 l = jnp.where(valid, l, 0.0)
                 gp = jax.tree.map(
                     lambda g: jnp.where(valid, g, 0.0).astype(jnp.float32),
                     gp,
                 )
+                gt = jax.tree.map(
+                    lambda g: jnp.where(valid, g, 0.0).astype(jnp.float32),
+                    gt,
+                )
                 gx_send = jnp.where(valid, gx, jnp.zeros_like(gx))
-                return (l, gp, gx_send.astype(in_dtype), mb_zero, stash,
+                return (l, gp, gt, gx_send.astype(in_dtype), mb_zero, stash,
                         gx_send.astype(jnp.float32))
 
-            (l, gp, gx_send, y_send, stash, gx_f32) = lax.cond(
+            (l, gp, gt, gx_send, y_send, stash, gx_f32) = lax.cond(
                 is_fwd, fwd_branch, bwd_branch, (fwd_recv, bwd_recv, stash)
             )
             gacc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), gacc, gp
+            )
+            gtacc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), gtacc, gt
             )
             loss_acc = loss_acc + l
             # stage 0's gx is d loss/d x for microbatch m_b — the embedding
@@ -310,35 +366,44 @@ def pipeline_value_and_grad(
             dx_buf = jnp.where(take_dx, dx_upd, dx_buf)
             fwd_next = lax.ppermute(y_send, axis, perm_r)
             bwd_next = lax.ppermute(gx_send.astype(in_dtype), axis, perm_l)
-            return (fwd_next, bwd_next, stash, gacc, loss_acc, dx_buf), None
+            return (fwd_next, bwd_next, stash, gacc, gtacc, loss_acc,
+                    dx_buf), None
 
         stash0 = jnp.zeros((S,) + mb_shape, in_dtype) + vzero_c
         dx0 = jnp.zeros((M,) + mb_shape, jnp.float32) + vzero
-        carry0 = (mb_zero, mb_zero, stash0, gzero, vzero, dx0)
-        (_, _, _, gacc, loss_acc, dx_buf), _ = lax.scan(
+        carry0 = (mb_zero, mb_zero, stash0, gzero, gtail_zero, vzero, dx0)
+        (_, _, _, gacc, gtacc, loss_acc, dx_buf), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
-        # loss lives on the last stage, dx on stage 0 — psum replicates both
-        # (each is zero elsewhere, so the sum is exact).
+        # loss + tail grads live on the last stage, dx on stage 0 — psum
+        # replicates each (zero elsewhere, so the sum is exact).
         loss = lax.psum(loss_acc, axis)
         dx = lax.psum(
             jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis
         )
+        gtail = jax.tree.map(lambda g: lax.psum(
+            jnp.where(idx == S - 1, g, jnp.zeros_like(g)), axis), gtacc)
         grads = jax.tree.map(
             lambda g, p: g.astype(p.dtype)[None], gacc, params
         )
-        return loss, grads, dx
+        return loss, grads, dx, gtail
 
-    loss, grads, dx = jax.shard_map(
+    loss, grads, dx, gtail = jax.shard_map(
         _local,
         mesh=mesh,
-        in_specs=(P(axis), P(), P()),
-        out_specs=(P(), P(axis), P()),
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(), P(axis), P(), P()),
         axis_names={axis},
         check_vma=True,
     )(
         stacked_params,
         x.astype(jnp.float32) if boundary_f32 else x,
         targets,
+        tail_params,
     )
-    return loss, grads, dx.astype(in_dtype)
+    if has_tail:
+        gtail = jax.tree.map(
+            lambda g, p: g.astype(jnp.asarray(p).dtype), gtail, tail_params
+        )
+    return PipelineVJP(loss, grads, dx.astype(in_dtype),
+                       gtail if has_tail else None)

@@ -152,15 +152,24 @@ def parse_args(argv=None) -> TrainArgs:
     return TrainArgs(**vars(ns))
 
 
-def _wrap_from_record(workload: Workload, fn):
-    """Apply the workload's device-side staging inverse (from_record) to
-    the batch before the loss — inside the compiled step, so uint8-staged
-    inputs dequantize on device (no-op for unstaged batches)."""
-    if workload.from_record is None or fn is None:
+def _wrap_from_record(workload: Workload, fn, *, train: bool = False):
+    """Apply the workload's device-side input transforms to the batch
+    before the loss — inside the compiled step: per-step augmentation
+    (``augment_fn``, TRAIN ONLY, on the raw possibly-uint8 batch) then the
+    staging inverse (``from_record``, no-op for unstaged batches)."""
+    aug = workload.augment_fn if train else None
+    fr = workload.from_record
+    if fn is None or (aug is None and fr is None):
         return fn
+
+    def pre(b, rng):
+        if aug is not None:
+            b = aug(b, rng)
+        return fr(b) if fr is not None else b
+
     if workload.stateful:
-        return lambda p, ms, b, rng: fn(p, ms, workload.from_record(b), rng)
-    return lambda p, b, rng: fn(p, workload.from_record(b), rng)
+        return lambda p, ms, b, rng: fn(p, ms, pre(b, rng), rng)
+    return lambda p, b, rng: fn(p, pre(b, rng), rng)
 
 
 def build_state_and_step(
@@ -221,7 +230,7 @@ def build_state_and_step(
                 "--grad_accum_steps"
             )
     raw_step = make_train_step(
-        _wrap_from_record(workload, workload.loss_fn),
+        _wrap_from_record(workload, workload.loss_fn, train=True),
         grad_accum_steps=grad_accum_steps,
         precision=precision,
         clip_grad_norm=workload.clip_grad_norm,

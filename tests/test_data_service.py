@@ -21,6 +21,7 @@ from distributed_tensorflow_tpu.data.service import (
 )
 from distributed_tensorflow_tpu.models import get_workload
 from distributed_tensorflow_tpu.native import RecordFile
+from tests.helpers import free_port
 
 REPO = os.path.dirname(os.path.dirname(__file__))
 
@@ -453,14 +454,6 @@ class TestDispatcherReadmission:
             disp.stop()
 
 
-def _free_port():
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 class TestDispatcherDurability:
     """VERDICT r4 missing #3: the dispatcher was the one remaining input
     SPOF for NEW participants.  With a registration journal, a SIGKILLed
@@ -477,7 +470,7 @@ class TestDispatcherDurability:
 
         path, rec, _ = indexed_record
         journal = str(tmp_path / "registry.journal")
-        port = _free_port()
+        port = free_port()
         env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
 
         def spawn_dispatcher():
@@ -544,7 +537,7 @@ class TestDispatcherDurability:
         )
 
         path, rec, _ = indexed_record
-        port = _free_port()
+        port = free_port()
         disp = DataServiceDispatcher(port=port).start()
         worker = DataServiceServer(path, rec, batch_size=8, shuffle=False,
                                    num_threads=1).start()

@@ -50,7 +50,7 @@ class TestFit:
         cb = RecordingCallback()
         history = model.fit(
             epochs=2, steps_per_epoch=10, callbacks=[cb],
-            validation_data=model.workload.data_fn(32), validation_steps=2,
+            validation_data=model.workload.data_fn, validation_steps=2,
         )
         assert cb.events[0] == "train_begin"
         assert cb.events[-1] == "train_end"
@@ -60,6 +60,14 @@ class TestFit:
         assert "val_loss" in epoch_ends[0][2]
         assert "val_loss" in history.history
         assert np.isfinite(history.history["val_loss"][0])
+
+    def test_one_shot_validation_iterator_rejected(self):
+        """A generator as validation_data would silently lose val_ metrics
+        after epoch 1 (keras re-iterates per epoch) — loud error instead."""
+        model = Model("mnist", batch_size=32)
+        gen = model.workload.data_fn(32)  # a one-shot generator
+        with pytest.raises(ValueError, match="re-iterable"):
+            model.fit(epochs=2, steps_per_epoch=2, validation_data=gen)
 
     def test_early_stopping_stops_training(self):
         model = Model("mnist", batch_size=32)

@@ -93,11 +93,11 @@ class TestPipeline:
         def loss_fn(y, t):
             return jnp.mean((y - t) ** 2)
 
-        l_1f1b, g_1f1b, dx_1f1b = pipeline_value_and_grad(
+        l_1f1b, g_1f1b, dx_1f1b, _ = pipeline_value_and_grad(
             stage_fn, loss_fn, stacked, x, tgt, mesh=mesh_pp,
             schedule="1f1b",
         )
-        l_gp, g_gp, dx_gp = pipeline_value_and_grad(
+        l_gp, g_gp, dx_gp, _ = pipeline_value_and_grad(
             stage_fn, loss_fn, stacked, x, tgt, mesh=mesh_pp,
             schedule="gpipe",
         )
@@ -123,6 +123,68 @@ class TestPipeline:
                                    rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(np.asarray(dx_gp), np.asarray(dx_seq),
                                    rtol=1e-4, atol=1e-5)
+
+    def test_1f1b_full_model_with_embedding_and_tied_head(self, mesh_pp):
+        """The deep-pipe composition recipe (PipelineVJP docstring): an
+        embedding feeds the pipeline, a trainable TIED head consumes it;
+        1F1B grads (stage + tail + embedding-through-dx, with the tied
+        table summing both paths) must equal plain autodiff of the
+        sequential model."""
+        V, d, M, mb, Tt = 32, 8, 8, 4, 6
+        rng = np.random.RandomState(7)
+        E = jnp.asarray(rng.randn(V, d).astype(np.float32) * 0.3)
+        stages = make_stages(4, dim=d)
+        stacked = stack_stage_params(stages)
+        stacked = jax.device_put(stacked, stage_sharding(mesh_pp, stacked))
+        tokens = jnp.asarray(rng.randint(0, V, size=(M, mb, Tt)))
+        tgt_tok = jnp.asarray(rng.randint(0, V, size=(M, mb, Tt)))
+
+        def embed_fn(E, tokens):
+            return E[tokens]  # (M, mb, T, d)
+
+        def head_loss(tp, y_mb, tgt_mb):
+            logits = y_mb @ tp["E"].T  # tied head
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1)
+            )
+
+        def run(schedule):
+            x, emb_vjp = jax.vjp(embed_fn, E, tokens)
+            r = pipeline_value_and_grad(
+                stage_fn, None, stacked, x, tgt_tok, mesh=mesh_pp,
+                schedule=schedule, tail_fn=head_loss,
+                tail_params={"E": E},
+            )
+            dE_emb, _ = emb_vjp(r.dx)
+            return r.loss, r.grads, dE_emb + r.tail_grads["E"]
+
+        # plain autodiff reference on the unrolled model
+        def ref_loss(E, stages_list):
+            x = embed_fn(E, tokens)
+
+            def per_mb(xm, tm):
+                h = xm
+                for p in stages_list:
+                    h = stage_fn(p, h)
+                return head_loss({"E": E}, h, tm)
+
+            return jnp.mean(jax.vmap(per_mb)(x, tgt_tok))
+
+        l_ref, (dE_ref, dstages_ref) = jax.value_and_grad(
+            ref_loss, argnums=(0, 1)
+        )(E, stages)
+        dstages_ref = stack_stage_params(dstages_ref)
+
+        for schedule in ("1f1b", "gpipe"):
+            loss, grads, dE = run(schedule)
+            np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(dE), np.asarray(dE_ref),
+                                       rtol=1e-4, atol=1e-5)
+            for a, b in zip(jax.tree.leaves(grads),
+                            jax.tree.leaves(dstages_ref)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)
 
     def test_1f1b_bounded_stash_memory(self):
         """1F1B's live set is the depth-S input ring, not GPipe's O(M) tick

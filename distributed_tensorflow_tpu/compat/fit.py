@@ -304,16 +304,6 @@ class Model:
         """
         self._build(total_steps=epochs * steps_per_epoch, for_training=True)
         self.stop_training = False
-        if (validation_data is not None and not callable(validation_data)
-                and not hasattr(validation_data, "as_numpy_iterator")
-                and iter(validation_data) is validation_data):
-            # A one-shot iterator/generator would exhaust after epoch 1 and
-            # val_ metrics would silently vanish (keras re-iterates
-            # validation_data each epoch) — refuse loudly instead.
-            raise ValueError(
-                "validation_data must be re-iterable per epoch (a list, "
-                "tf.data.Dataset, or data_fn callable) — got a one-shot "
-                "iterator/generator")
         keras_cbs = [cb for cb in callbacks if not isinstance(cb, Hook)]
         hook_cbs = [cb for cb in callbacks if isinstance(cb, Hook)]
         for cb in keras_cbs:
@@ -350,15 +340,23 @@ class Model:
                 logs = bridge.epoch_mean.report_and_reset()
                 if validation_data is not None:
                     # fresh iterator per epoch (keras re-iterates
-                    # validation_data each epoch; a shared iterator would
-                    # exhaust a finite set after epoch 1 and silently stop
-                    # producing val_ metrics)
+                    # validation_data each epoch)
                     val_iter = self._device_batches(
                         validation_data, for_eval=True)
-                    logs.update({
-                        f"val_{k}": v for k, v in self._eval_loop(
-                            val_iter, validation_steps).items()
-                    })
+                    val_logs = self._eval_loop(val_iter, validation_steps)
+                    if not val_logs:
+                        # A finite one-shot iterator exhausted in an
+                        # earlier epoch: val_ metrics would silently
+                        # vanish from History (and EarlyStopping would
+                        # never fire).  Infinite generators and
+                        # re-iterables never hit this.
+                        raise ValueError(
+                            "validation_data yielded no batches in epoch "
+                            f"{epoch}: it must be re-iterable per epoch "
+                            "(a list, tf.data.Dataset, or data_fn "
+                            "callable), not a finite one-shot iterator")
+                    logs.update({f"val_{k}": v
+                                 for k, v in val_logs.items()})
                 history._record(epoch, logs)
                 bridge._dispatch("on_epoch_end", epoch, logs)
         finally:

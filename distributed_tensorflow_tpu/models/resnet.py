@@ -20,7 +20,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,7 +65,7 @@ def dequantize_images(batch):
     return out
 
 
-def augment_images(batch, rng, *, pad: int = None):
+def augment_images(batch, rng, *, pad: Optional[int] = None):
     """Per-step train augmentation (Workload.augment_fn): random horizontal
     flip + random pad-crop, ON DEVICE inside the compiled step.
 

@@ -68,6 +68,7 @@ class TrainArgs:
     pipe: int = 1
     context: int = 1
     expert: int = 1
+    table_dtype: str = "f32"  # wide_deep: stored embedding-row dtype
     # launcher contract
     job_name: Optional[str] = None
     task_index: Optional[int] = None
@@ -114,6 +115,10 @@ def parse_args(argv=None) -> TrainArgs:
         p.add_argument(f"--{axis}", type=int,
                        default=-1 if axis == "data" else 1,
                        help=f"mesh size of the {axis!r} axis")
+    p.add_argument("--table_dtype", choices=("f32", "bf16"), default="f32",
+                   help="wide_deep: stored embedding-row dtype (bf16 halves "
+                        "table param bytes; optimizer keeps an f32 master — "
+                        "measured ~3% slower on v5e, BASELINE.md r5)")
     p.add_argument("--job_name", type=str, default=None,
                    help="TF1 launcher contract: ps|worker|chief|evaluator")
     p.add_argument("--task_index", type=int, default=None)
@@ -329,6 +334,11 @@ def run(args: TrainArgs) -> Dict[str, Any]:
                 f"--model={args.model} --arch={args.arch}"
             )
         overrides["arch"] = args.arch
+    if args.table_dtype != "f32":
+        if args.model != "wide_deep":
+            raise ValueError("--table_dtype applies to --model=wide_deep "
+                             "(the embedding-table workloads)")
+        overrides["table_dtype"] = args.table_dtype
     if args.flash_attention:
         if args.model not in ("gpt2", "bert"):
             raise ValueError("--flash_attention applies to gpt2/bert "

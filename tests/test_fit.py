@@ -61,13 +61,25 @@ class TestFit:
         assert "val_loss" in history.history
         assert np.isfinite(history.history["val_loss"][0])
 
-    def test_one_shot_validation_iterator_rejected(self):
-        """A generator as validation_data would silently lose val_ metrics
-        after epoch 1 (keras re-iterates per epoch) — loud error instead."""
+    def test_finite_one_shot_validation_iterator_fails_loudly(self):
+        """A FINITE generator as validation_data exhausts after epoch 1;
+        val_ metrics must not silently vanish (keras re-iterates per
+        epoch) — loud error instead.  An INFINITE generator (the synthetic
+        data_fn stream) keeps working."""
         model = Model("mnist", batch_size=32)
-        gen = model.workload.data_fn(32)  # a one-shot generator
+        batches = [next(model.workload.data_fn(32)) for _ in range(2)]
+        finite = iter(batches)
         with pytest.raises(ValueError, match="re-iterable"):
-            model.fit(epochs=2, steps_per_epoch=2, validation_data=gen)
+            model.fit(epochs=2, steps_per_epoch=2, validation_data=finite,
+                      validation_steps=2)
+
+        infinite = Model("mnist", batch_size=32)
+        history = infinite.fit(
+            epochs=2, steps_per_epoch=2,
+            validation_data=infinite.workload.data_fn(32),
+            validation_steps=2,
+        )
+        assert len(history.history["val_loss"]) == 2
 
     def test_early_stopping_stops_training(self):
         model = Model("mnist", batch_size=32)

@@ -139,6 +139,18 @@ class TestEval:
         finally:
             mgr.close()
 
+    def test_table_dtype_flag_parses_and_validates(self):
+        from distributed_tensorflow_tpu.train_lib import parse_args
+
+        args = parse_args(["--model=wide_deep", "--table_dtype=bf16"])
+        assert args.table_dtype == "bf16"
+        import pytest
+
+        from distributed_tensorflow_tpu.train_lib import TrainArgs, run
+
+        with pytest.raises(ValueError, match="table_dtype"):
+            run(TrainArgs(model="mnist", table_dtype="bf16", steps=1))
+
     def test_evaluator_role_consumes_checkpoints(self, tmp_path):
         from distributed_tensorflow_tpu.train_lib import (
             TrainArgs,

@@ -73,6 +73,11 @@ class GPT2Config:
     # largest of {4S, 2S, S} dividing the batch).  Bubble fraction is
     # (S-1)/(M+S-1), so prefer M >= 4S.
     pipe_microbatches: int = 0
+    # Pipeline schedule at pipe>1: "gpipe" (autodiff through the forward
+    # scan — O(M) activation stash) or "1f1b" (combined fwd/bwd scan with
+    # a depth-(2S-1) input ring stash + remat backward — the deep-pipe
+    # memory answer; parallel/pipeline.py).  Same math either way.
+    pipe_schedule: str = "gpipe"
     # Ring attention kv-chunk size (0 = whole per-shard blocks): bounds the
     # per-ring-step score tile to (T/shards, ring_chunk_size) — set for
     # pod-scale per-shard sequence lengths (see parallel.ring_attention).
@@ -239,47 +244,63 @@ class GPT2(nn.Module):
         ``parallel.pipeline.pipeline_apply`` (shard_map manual over ``pipe``
         only, so TP/DP inside each stage stay GSPMD-driven).  Embeddings,
         final LN, and the LM head run outside the pipeline, replicated over
-        the pipe axis.
+        the pipe axis.  Stage construction is shared with the 1F1B path
+        (``_pipe_stage_fn``/``_pipe_staging``) so the two schedules cannot
+        drift apart structurally.
         """
         from distributed_tensorflow_tpu.parallel.pipeline import (
             pipeline_apply,
         )
 
-        cfg = self.cfg
-        L, S = cfg.n_layer, n_stages
-        if L % S != 0:
-            raise ValueError(f"n_layer={L} not divisible by pipe={S}")
         params = self.scope.get_variable("params", "blocks")
-        staged = jax.tree.map(
-            lambda p: jnp.reshape(p, (S, L // S) + p.shape[1:]), params
-        )
-        block = Block(cfg, mesh=None, deterministic=True)
+        staged, xm, _ = _pipe_staging(self.cfg, self.mesh, params, x)
+        y = pipeline_apply(_pipe_stage_fn(self.cfg), staged, xm,
+                           mesh=self.mesh, axis="pipe")
+        return jnp.reshape(y, x.shape)
 
-        def stage_fn(stage_params, h):
-            def body(h, layer_params):
-                h, _ = block.apply({"params": layer_params}, h)
-                return h, None
 
-            if cfg.remat:
-                body = jax.checkpoint(body, prevent_cse=False)
-            h, _ = jax.lax.scan(body, h, stage_params)
-            return h
+def _pipe_stage_fn(cfg):
+    """One pipeline stage = a scan over its L/S layers (remat per layer),
+    SHARED by the GPipe (``_pipelined_blocks``) and 1F1B
+    (``_pipe_1f1b_loss``) paths — one definition, zero schedule drift."""
+    block = Block(cfg, mesh=None, deterministic=True)
 
-        B, T, d = x.shape
-        M = cfg.pipe_microbatches or _auto_microbatches(B, S)
-        if B % M != 0:
-            raise ValueError(f"batch {B} not divisible by microbatches {M}")
-        xm = jnp.reshape(x, (M, B // M, T, d))
-        if self.mesh is not None:
-            # Keep the microbatch (not the schedule) dim data-sharded.
-            xm = jax.lax.with_sharding_constraint(
-                xm,
-                jax.sharding.NamedSharding(
-                    self.mesh, P(None, ("data", "fsdp"))
-                ),
-            )
-        y = pipeline_apply(stage_fn, staged, xm, mesh=self.mesh, axis="pipe")
-        return jnp.reshape(y, (B, T, d))
+    def stage_fn(stage_params, h):
+        def body(h, layer_params):
+            h, _ = block.apply({"params": layer_params}, h)
+            return h, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    return stage_fn
+
+
+def _pipe_staging(cfg, mesh, blocks_params, x):
+    """(staged blocks params, microbatched x, M) for the pipeline paths.
+
+    Re-views (L, ...) block params as (S, L/S, ...) contiguous stages and
+    the (B, ...) batch as (M, B/M, ...) microbatches, with the microbatch
+    dim kept data-sharded.  Shared by both schedules (see _pipe_stage_fn).
+    """
+    S = mesh.shape["pipe"]
+    L = cfg.n_layer
+    if L % S != 0:
+        raise ValueError(f"n_layer={L} not divisible by pipe={S}")
+    staged = jax.tree.map(
+        lambda p: jnp.reshape(p, (S, L // S) + p.shape[1:]), blocks_params
+    )
+    B = x.shape[0]
+    M = cfg.pipe_microbatches or _auto_microbatches(B, S)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    xm = jnp.reshape(x, (M, B // M) + x.shape[1:])
+    xm = jax.lax.with_sharding_constraint(
+        xm, jax.sharding.NamedSharding(mesh, P(None, ("data", "fsdp")))
+    )
+    return staged, xm, M
 
 
 def _auto_microbatches(batch: int, n_stages: int) -> int:
@@ -327,6 +348,90 @@ def _chunked_ce(hidden, wte, tokens, chunk, dtype):
         (hs, ts, ws),
     )
     return total / (B * (T - 1))
+
+
+def _pipe_1f1b_loss(module: "GPT2", params, batch: Dict[str, jax.Array],
+                    rng):
+    """Training loss for ``--pipe`` under the 1F1B schedule.
+
+    The GPipe path differentiates through ``pipeline_apply`` inside
+    ``module.apply`` (autodiff stashes O(M) tick activations); this path
+    drives ``parallel.pipeline.pipeline_value_and_grad(schedule="1f1b")``
+    — forward AND backward are ONE combined scan with a depth-(2S-1)
+    input ring stash — and hands the precomputed gradients to the
+    standard train step
+    through a ``custom_vjp`` whose backward merely scales them.
+    Composition per ``PipelineVJP``'s docstring: token+position embedding
+    under ``jax.vjp`` outside the schedule, the scanned block stack as
+    stages, final LN + tied LM head + CE as the trainable tail on the last
+    stage.  The tied ``wte`` gradient is the SUM of the embedding-path
+    (via ``r.dx``) and head-path (``r.tail_grads``) cotangents.
+    """
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        pipeline_value_and_grad,
+    )
+
+    cfg = module.cfg
+    mesh = module.mesh
+    tokens = batch["tokens"]
+    B, T = tokens.shape
+    d = cfg.d_model
+    stage_fn = _pipe_stage_fn(cfg)
+    ln_f = nn.LayerNorm(dtype=jnp.float32)
+
+    def tail_fn(tp, y_mb, t_mb):
+        h = ln_f.apply({"params": tp["ln_f"]}, y_mb)
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            h.astype(cfg.dtype),
+            tp["wte"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t_mb[:, 1:]
+            )
+        )
+
+    def _compute(p):
+        def embed(wte, wpe):
+            return wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+
+        x, emb_vjp = jax.vjp(embed, p["wte"], p["wpe"])
+        staged, xm, M = _pipe_staging(cfg, mesh, p["blocks"], x)
+        tm = jnp.reshape(tokens, (M, B // M, T))
+        r = pipeline_value_and_grad(
+            stage_fn, None, staged, xm, tm, mesh=mesh, axis="pipe",
+            schedule="1f1b", tail_fn=tail_fn,
+            tail_params={"ln_f": p["ln_f"], "wte": p["wte"]},
+        )
+        d_wte_emb, d_wpe = emb_vjp(
+            jnp.reshape(r.dx, (B, T, d)).astype(x.dtype)
+        )
+        grads = {
+            "blocks": jax.tree.map(
+                lambda g: jnp.reshape(g, (cfg.n_layer,) + g.shape[2:]),
+                r.grads
+            ),
+            "ln_f": r.tail_grads["ln_f"],
+            "wte": d_wte_emb + r.tail_grads["wte"],
+            "wpe": d_wpe,
+        }
+        return r.loss, grads
+
+    @jax.custom_vjp
+    def pipe_loss(p):
+        return _compute(p)[0]
+
+    def _fwd(p):
+        return _compute(p)
+
+    def _bwd(grads, ct):
+        return (jax.tree.map(lambda g: (g * ct).astype(g.dtype), grads),)
+
+    pipe_loss.defvjp(_fwd, _bwd)
+    loss = pipe_loss(params)
+    return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
 
 
 def _loss_fn(module: nn.Module, deterministic: bool, params,
@@ -445,6 +550,7 @@ def make_workload(
     use_flash_attention: Optional[bool] = None,
     ring_chunk_size: Optional[int] = None,
     ce_chunk: Optional[int] = None,
+    pipe_schedule: Optional[str] = None,
     **_unused,
 ) -> Workload:
     cfg = config or getattr(GPT2Config, preset)()
@@ -454,6 +560,11 @@ def make_workload(
         cfg = dataclasses.replace(cfg, ring_chunk_size=ring_chunk_size)
     if ce_chunk is not None:
         cfg = dataclasses.replace(cfg, ce_chunk=ce_chunk)
+    if pipe_schedule is not None:
+        cfg = dataclasses.replace(cfg, pipe_schedule=pipe_schedule)
+    if cfg.pipe_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pipe_schedule must be gpipe|1f1b, got {cfg.pipe_schedule!r}")
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
         if not cfg.scan_layers:
             raise ValueError(
@@ -474,6 +585,13 @@ def make_workload(
                 "pipe>1: disabling dropout (GPipe stage fn is deterministic)"
             )
             cfg = dataclasses.replace(cfg, dropout=0.0)
+    pipe_1f1b = (mesh is not None and mesh.shape.get("pipe", 1) > 1
+                 and cfg.pipe_schedule == "1f1b")
+    if pipe_1f1b and cfg.ce_chunk:
+        raise ValueError(
+            "ce_chunk with pipe_schedule='1f1b' is unsupported: the 1F1B "
+            "tail computes each microbatch's logits in full (microbatches "
+            "already bound the live logits to (B/M, T, V))")
     seq = seq_len or min(cfg.n_positions, 1024)
     _guard_dense_attention_memory(
         cfg, seq=seq, batch_size=batch_size,
@@ -488,7 +606,8 @@ def make_workload(
     return Workload(
         name="gpt2",
         module=module,
-        loss_fn=functools.partial(_loss_fn, module, False),
+        loss_fn=(functools.partial(_pipe_1f1b_loss, module) if pipe_1f1b
+                 else functools.partial(_loss_fn, module, False)),
         eval_loss_fn=functools.partial(_loss_fn, module, True),
         init_batch={"tokens": np.zeros((b0, seq), np.int32)},
         data_fn=lambda per_host_bs: synthetic_lm(

@@ -26,12 +26,16 @@ Two schedules:
   microbatches).  Fine at pipe=2; the stash grows with M.
 - ``pipeline_value_and_grad(schedule="1f1b")`` — one-scan combined
   forward+backward (non-interleaved 1F1B): each stage starts backward as
-  soon as its first microbatch returns, so at most S microbatch *inputs*
-  are ever stashed per stage (a ring buffer in the scan carry), and the
-  backward rematerialises the stage forward from the stashed input
-  (``jax.vjp`` inside the tick).  Memory: O(S) stash vs GPipe's O(M);
-  compute: one extra stage forward per microbatch (the remat) — the
-  standard deep-pipe trade.  Same bubble fraction as GPipe.
+  soon as its first microbatch returns, so at most 2S-1 microbatch
+  *inputs* are ever stashed per stage (a ring buffer in the scan carry),
+  and the backward rematerialises the stage forward from the stashed
+  input (``jax.vjp`` inside the tick).  Memory: O(S) stash vs GPipe's
+  O(M); compute: one extra stage forward per microbatch (the remat) —
+  the standard deep-pipe trade.  Crucially the schedule contains NO
+  data-dependent control flow (every tick runs one fwd + one masked bwd
+  on every stage, cotangent seeds selected by ``where``), so GSPMD
+  collectives inside the stages (tensor/fsdp sharding) stay uniform
+  across devices — see the in-body note for the deadlock this avoids.
 
 Composition with the other mesh axes: the shard_map is *manual only over the
 pipe axis* (``axis_names={axis}``) — data/fsdp/tensor/context stay "auto",
@@ -215,14 +219,14 @@ def pipeline_value_and_grad(
 
     schedule="gpipe": differentiate through ``pipeline_apply`` (autodiff
     stashes O(M) tick activations — the scan transpose).
-    schedule="1f1b": one combined scan of 2(M+S-1) half-ticks; tick parity
-    alternates forward/backward per stage, a depth-S ring buffer in the
-    carry stashes stage *inputs*, and each backward tick re-runs the stage
-    forward under ``jax.vjp`` (rematerialisation).  Losses and gradients are
-    the same math to floating-point tolerance (remat and per-microbatch
-    ``loss/M`` accumulation reorder the ops, so exact-equality golden tests
-    against "gpipe" will not hold) — only peak memory and the remat FLOPs
-    differ materially.
+    schedule="1f1b": one combined scan of M+2S-1 full ticks; every tick
+    runs one forward and one (masked) backward per stage, a depth-(2S-1)
+    ring buffer in the carry stashes stage *inputs*, and each backward
+    re-runs the stage forward under ``jax.vjp`` (rematerialisation).
+    Losses and gradients are the same math to floating-point tolerance
+    (remat and per-microbatch ``loss/M`` accumulation reorder the ops, so
+    exact-equality golden tests against "gpipe" will not hold) — only
+    peak memory and the remat FLOPs differ materially.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule: {schedule!r}")
@@ -254,20 +258,17 @@ def pipeline_value_and_grad(
     def _local(params, x_loc, tgt_loc, tail_p):
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
         idx = lax.axis_index(axis)
-        T = 2 * (M + S - 1)
+        R = 2 * S - 1  # stash ring depth (max fwd->bwd distance, stage 0)
+        T = M + 2 * S - 1
         mb_shape = x_loc.shape[1:]
         vzero = (idx * 0).astype(jnp.float32)
         vzero_c = vzero.astype(in_dtype)
-        # Pipe-VARYING zeros: both cond branches must produce identically
-        # varying outputs, and adding a varying zero is the collective-free
-        # promotion (see pipeline_apply).
+        # Pipe-VARYING zeros (zero-add is the collective-free promotion —
+        # see pipeline_apply).
         mb_zero = jnp.zeros(mb_shape, in_dtype) + vzero_c
-        mb_zero_f32 = jnp.zeros(mb_shape, jnp.float32) + vzero
         gzero = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32) + vzero, params
         )
-        # Promote tail params to pipe-varying (zero-add, collective-free):
-        # their vjp cotangent must type identically in both cond branches.
         tail_p = jax.tree.map(
             lambda p: p + vzero.astype(jnp.asarray(p).dtype), tail_p
         )
@@ -277,99 +278,99 @@ def pipeline_value_and_grad(
         perm_r = [(i, (i + 1) % S) for i in range(S)]
         perm_l = [((i + 1) % S, i) for i in range(S)]
 
-        # Half-tick schedule (derivation in the module docstring's terms):
-        #   forward of microbatch m on stage s at tick  2m + s
-        #   backward of microbatch m on stage s at tick 2m + 2S - 1 - s
-        # so ticks alternate parity per stage ((t - s) even = forward), the
-        # cotangent a stage consumes at tick t was produced by stage s+1 at
-        # t-1, and slot m mod S in the stash is always freed (backward of
-        # m-S at tick 2m-1-s) before it is rewritten (forward of m at
-        # 2m+s).  Total ticks 2(M+S-1): bubble (S-1)/(M+S-1), same as GPipe.
+        # Full-tick 1F1B with NO data-dependent control flow: every tick,
+        # every stage runs ONE forward (microbatch m_f = t - s) and ONE
+        # backward (m_b = t - (2S-1-s), i.e. 2(S-1-s)+1 ticks after that
+        # microbatch's forward here), both unconditionally — bubble ticks
+        # compute on garbage and are masked out with `where`.  This
+        # uniformity is load-bearing, not a style choice: the stages run
+        # under GSPMD sub-sharding (tensor/fsdp collectives INSIDE
+        # stage_fn), and collectives inside branch-divergent control flow
+        # deadlock — an earlier half-tick design with
+        # `lax.cond(is_fwd, ...)` hung XLA:CPU's collective rendezvous
+        # with half the devices parked at each of two ppermutes as soon as
+        # tensor>1 ("Expected 8 threads to join, only 4 arrived").  The
+        # backward differentiates ONE function (y, loss) = f(params, x,
+        # tail) and selects the cotangent seed instead of the branch:
+        # last stage seeds (0, 1/M), others seed (bwd_recv, 0) — so the
+        # collective sequence is identical on every device.  Cost per tick
+        # ~ 1 fwd + (remat fwd + bwd): the standard 1F1B remat trade.
+        # NOTE: tail_fn/loss_fn run (masked) on EVERY stage's
+        # activations, so they must be finite on intermediate values
+        # (softmax-CE, MSE etc. are; a log of a raw activation is not).
+        # Stash ring: slot m % R; stage 0 frees slot (m-R) the same tick
+        # forward rewrites it — backward reads BEFORE forward writes below.
         def tick(carry, t):
             (fwd_recv, bwd_recv, stash, gacc, gtacc, loss_acc,
              dx_buf) = carry
-            is_fwd = ((t - idx) % 2) == 0
-            m_f = (t - idx) // 2
-            m_b = (t - (2 * S - 1 - idx)) // 2
+            m_f = t - idx
+            m_b = t - (2 * S - 1 - idx)
+            valid_f = (m_f >= 0) & (m_f < M)
+            valid_b = (m_b >= 0) & (m_b < M)
+            is_last = idx == S - 1
 
-            def fwd_branch(ops):
-                fwd_recv, bwd_recv, stash = ops
-                valid = (m_f >= 0) & (m_f < M)
-                x_t = lax.dynamic_index_in_dim(
-                    x_loc, jnp.clip(m_f, 0, M - 1), 0, keepdims=False
-                ).astype(in_dtype)
-                inp = jnp.where(idx == 0, x_t, fwd_recv)
-                y = stage_fn(params, inp)
-                upd = lax.dynamic_update_index_in_dim(
-                    stash, inp, m_f % S, 0
-                )
-                stash = jnp.where(valid, upd, stash)
-                y_send = jnp.where(valid, y, jnp.zeros_like(y))
-                return (vzero, gzero, gtail_zero, mb_zero, y_send, stash,
-                        mb_zero_f32)
-
-            def bwd_branch(ops):
-                fwd_recv, bwd_recv, stash = ops
-                valid = (m_b >= 0) & (m_b < M)
-                x_in = lax.dynamic_index_in_dim(
-                    stash, m_b % S, 0, keepdims=False
-                )
-                tgt = lax.dynamic_index_in_dim(
-                    tgt_loc, jnp.clip(m_b, 0, M - 1), 0, keepdims=False
-                )
-
-                def last_stage(_):
-                    l, pb = jax.vjp(
-                        lambda p, xi, tp: mb_loss(tp, stage_fn(p, xi), tgt),
-                        params, x_in, tail_p,
-                    )
-                    gp, gx, gt = pb(jnp.ones_like(l) / M)
-                    gt = jax.tree.map(lambda g: g.astype(jnp.float32), gt)
-                    return l.astype(jnp.float32) / M, gp, gt, gx
-
-                def mid_stage(_):
-                    _, pb = jax.vjp(stage_fn, params, x_in)
-                    gp, gx = pb(bwd_recv)
-                    return vzero, gp, gtail_zero, gx
-
-                l, gp, gt, gx = lax.cond(idx == S - 1, last_stage,
-                                         mid_stage, None)
-                l = jnp.where(valid, l, 0.0)
-                gp = jax.tree.map(
-                    lambda g: jnp.where(valid, g, 0.0).astype(jnp.float32),
-                    gp,
-                )
-                gt = jax.tree.map(
-                    lambda g: jnp.where(valid, g, 0.0).astype(jnp.float32),
-                    gt,
-                )
-                gx_send = jnp.where(valid, gx, jnp.zeros_like(gx))
-                return (l, gp, gt, gx_send.astype(in_dtype), mb_zero, stash,
-                        gx_send.astype(jnp.float32))
-
-            (l, gp, gt, gx_send, y_send, stash, gx_f32) = lax.cond(
-                is_fwd, fwd_branch, bwd_branch, (fwd_recv, bwd_recv, stash)
+            # ---- backward (reads its stash slot first; see ring note) --
+            x_in = lax.dynamic_index_in_dim(
+                stash, m_b % R, 0, keepdims=False
             )
+            tgt = lax.dynamic_index_in_dim(
+                tgt_loc, jnp.clip(m_b, 0, M - 1), 0, keepdims=False
+            )
+
+            def fwd_and_loss(p, xi, tp):
+                y = stage_fn(p, xi)
+                return y, mb_loss(tp, y, tgt)
+
+            (y_b, l_b), pb = jax.vjp(fwd_and_loss, params, x_in, tail_p)
+            ybar = jnp.where(is_last | ~valid_b,
+                             jnp.zeros_like(y_b), bwd_recv)
+            lbar = jnp.where(is_last & valid_b, 1.0 / M, 0.0).astype(
+                l_b.dtype)
+            gp, gx, gt = pb((ybar, lbar))
             gacc = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), gacc, gp
+                lambda a, g: a + jnp.where(valid_b, g, 0.0).astype(
+                    jnp.float32), gacc, gp,
             )
             gtacc = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), gtacc, gt
+                lambda a, g: a + jnp.where(valid_b, g, 0.0).astype(
+                    jnp.float32), gtacc, gt,
             )
-            loss_acc = loss_acc + l
-            # stage 0's gx is d loss/d x for microbatch m_b — the embedding
-            # hand-off; other stages' gx rides the ring to the left.
-            take_dx = (idx == 0) & ((m_b >= 0) & (m_b < M)) & (~is_fwd)
+            loss_acc = loss_acc + jnp.where(
+                is_last & valid_b, l_b.astype(jnp.float32) / M, 0.0
+            )
+            # stage 0's gx is d loss/d x for microbatch m_b — the
+            # embedding hand-off; other stages' gx rides the ring left.
+            take_dx = (idx == 0) & valid_b
             dx_upd = lax.dynamic_update_index_in_dim(
-                dx_buf, gx_f32, jnp.clip(m_b, 0, M - 1), 0
+                dx_buf, gx.astype(jnp.float32), jnp.clip(m_b, 0, M - 1), 0
             )
             dx_buf = jnp.where(take_dx, dx_upd, dx_buf)
+
+            # ---- forward -----------------------------------------------
+            x_t = lax.dynamic_index_in_dim(
+                x_loc, jnp.clip(m_f, 0, M - 1), 0, keepdims=False
+            ).astype(in_dtype)
+            inp = jnp.where(idx == 0, x_t, fwd_recv)
+            y_f = stage_fn(params, inp)
+            stash = jnp.where(
+                valid_f,
+                lax.dynamic_update_index_in_dim(stash, inp, m_f % R, 0),
+                stash,
+            )
+            y_send = jnp.where(valid_f, y_f, jnp.zeros_like(y_f))
+            gx_send = jnp.where(valid_b, gx, jnp.zeros_like(gx)).astype(
+                in_dtype)
+
             fwd_next = lax.ppermute(y_send, axis, perm_r)
-            bwd_next = lax.ppermute(gx_send.astype(in_dtype), axis, perm_l)
+            # Pin the issue ORDER of the two (data-independent) ppermutes:
+            # the partitioner may otherwise schedule them differently per
+            # partitioned program and deadlock the rendezvous.
+            order_pin = (fwd_next.reshape(-1)[0] * 0).astype(in_dtype)
+            bwd_next = lax.ppermute(gx_send + order_pin, axis, perm_l)
             return (fwd_next, bwd_next, stash, gacc, gtacc, loss_acc,
                     dx_buf), None
 
-        stash0 = jnp.zeros((S,) + mb_shape, in_dtype) + vzero_c
+        stash0 = jnp.zeros((R,) + mb_shape, in_dtype) + vzero_c
         dx0 = jnp.zeros((M,) + mb_shape, jnp.float32) + vzero
         carry0 = (mb_zero, mb_zero, stash0, gzero, gtail_zero, vzero, dx0)
         (_, _, _, gacc, gtacc, loss_acc, dx_buf), _ = lax.scan(

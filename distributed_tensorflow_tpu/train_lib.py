@@ -56,6 +56,7 @@ class TrainArgs:
     # dropout runs in-kernel — see GPT2Config)
     ring_chunk_size: int = 0  # gpt2/bert with --context>1: kv-chunk size
     # bounding per-ring-step attention memory (0 = whole blocks)
+    pipe_schedule: str = "gpipe"  # gpt2 with --pipe>1: gpipe | 1f1b
     steps: int = 200
     batch_size: Optional[int] = None  # global; default from workload
     grad_accum_steps: Optional[int] = None
@@ -106,6 +107,12 @@ def parse_args(argv=None) -> TrainArgs:
                         "kv blocks in chunks of this many keys (bounds "
                         "per-ring-step memory at long per-shard sequence "
                         "lengths; 0 = whole blocks)")
+    p.add_argument("--pipe_schedule", choices=("gpipe", "1f1b"),
+                   default="gpipe",
+                   help="gpt2 with --pipe>1: GPipe (autodiff backward, "
+                        "O(M) activation stash) or 1F1B (combined fwd/bwd "
+                        "scan, depth-(2S-1) input ring stash + remat — "
+                        "deep-pipe memory)")
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--grad_accum_steps", type=int, default=None)
@@ -352,6 +359,13 @@ def run(args: TrainArgs) -> Dict[str, Any]:
             raise ValueError("--ring_chunk_size requires --context>1 "
                              "(ring attention is the context-axis path)")
         overrides["ring_chunk_size"] = args.ring_chunk_size
+    if args.pipe_schedule != "gpipe":
+        if args.model != "gpt2":
+            raise ValueError("--pipe_schedule applies to --model=gpt2 "
+                             "(the pipelined workload)")
+        if args.pipe <= 1:
+            raise ValueError("--pipe_schedule=1f1b requires --pipe>1")
+        overrides["pipe_schedule"] = args.pipe_schedule
     workload = get_workload(args.model, **overrides)
     grad_accum = args.grad_accum_steps or workload.grad_accum_steps
     precision = BF16 if args.precision == "bf16" else FP32

@@ -272,6 +272,27 @@ class TestGPT2:
         l_pp = [m["loss"] for m in run_steps(make(mesh_pp), mesh_pp, 3)[1]]
         np.testing.assert_allclose(l_dp, l_pp, rtol=2e-2)
 
+    def test_pipe_1f1b_matches_gpipe_loss(self):
+        """--pipe_schedule=1f1b trains the flagship through the combined
+        fwd/bwd 1F1B scan (custom_vjp hands precomputed grads to the
+        standard step); its loss trajectory must match GPipe's (same math,
+        different schedule + remat)."""
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        mesh = build_mesh(MeshConfig(data=2, tensor=2, pipe=2),
+                          jax.devices())
+
+        def losses(schedule):
+            wl = get_workload(
+                "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+                grad_accum_steps=1, mesh=mesh, pipe_schedule=schedule,
+            )
+            return [m["loss"] for m in run_steps(wl, mesh, 3)[1]]
+
+        np.testing.assert_allclose(losses("gpipe"), losses("1f1b"),
+                                   rtol=2e-2)
+
     def test_pipeline_stage_params_sharded_over_pipe(self):
         from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
         from distributed_tensorflow_tpu.models.gpt2 import GPT2Config

@@ -200,7 +200,7 @@ class GPT2(nn.Module):
                     "carries no per-layer rng); set dropout=0 — "
                     "make_workload does this automatically"
                 )
-            x = self._pipelined_blocks(x, pipe)
+            x = self._pipelined_blocks(x)
         elif cfg.scan_layers:
             body = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
             Scanned = nn.scan(
@@ -236,7 +236,7 @@ class GPT2(nn.Module):
         )
         return logits
 
-    def _pipelined_blocks(self, x, n_stages: int):
+    def _pipelined_blocks(self, x):
         """Apply the scanned block stack through the GPipe schedule.
 
         The (L, ...) "blocks" parameters are re-viewed as (S, L/S, ...) —
@@ -350,6 +350,25 @@ def _chunked_ce(hidden, wte, tokens, chunk, dtype):
     return total / (B * (T - 1))
 
 
+def _tied_head_ce(hidden, wte, tokens, dtype):
+    """Weight-tied LM head + shifted next-token mean CE — THE training
+    recipe in one place, shared by the dense path (``_loss_fn``) and the
+    1F1B tail (``_pipe_1f1b_loss``); ``_chunked_ce`` mirrors it per
+    T-chunk.  bf16 operands on the MXU (f32 runs at half the MXU rate on
+    v5e), f32 accumulation/output for a stable softmax."""
+    logits = jnp.einsum(
+        "btd,vd->btv",
+        hidden.astype(dtype),
+        wte.astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]
+        )
+    )
+
+
 def _pipe_1f1b_loss(module: "GPT2", params, batch: Dict[str, jax.Array],
                     rng):
     """Training loss for ``--pipe`` under the 1F1B schedule.
@@ -381,17 +400,7 @@ def _pipe_1f1b_loss(module: "GPT2", params, batch: Dict[str, jax.Array],
 
     def tail_fn(tp, y_mb, t_mb):
         h = ln_f.apply({"params": tp["ln_f"]}, y_mb)
-        logits = jnp.einsum(
-            "btd,vd->btv",
-            h.astype(cfg.dtype),
-            tp["wte"].astype(cfg.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        return jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], t_mb[:, 1:]
-            )
-        )
+        return _tied_head_ce(h, tp["wte"], t_mb, cfg.dtype)
 
     def _compute(p):
         def embed(wte, wpe):
@@ -447,15 +456,11 @@ def _loss_fn(module: nn.Module, deterministic: bool, params,
         loss = _chunked_ce(hidden, params["wte"], tokens, cfg.ce_chunk,
                            cfg.dtype)
         return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
-    logits = module.apply(
+    hidden = module.apply(
         {"params": params}, tokens, deterministic=deterministic, rngs=rngs,
+        return_hidden=True,
     )
-    # next-token prediction: shift left
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    loss = jnp.mean(
-        optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    )
+    loss = _tied_head_ce(hidden, params["wte"], tokens, cfg.dtype)
     return loss, {"perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
 
 
@@ -565,6 +570,12 @@ def make_workload(
     if cfg.pipe_schedule not in ("gpipe", "1f1b"):
         raise ValueError(
             f"pipe_schedule must be gpipe|1f1b, got {cfg.pipe_schedule!r}")
+    if cfg.pipe_schedule == "1f1b" and not (
+            mesh is not None and mesh.shape.get("pipe", 1) > 1):
+        raise ValueError(
+            "pipe_schedule='1f1b' requires a mesh with pipe>1; without one "
+            "it would silently train the non-pipelined path instead of the "
+            "schedule you asked for")
     if mesh is not None and mesh.shape.get("pipe", 1) > 1:
         if not cfg.scan_layers:
             raise ValueError(

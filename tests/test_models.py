@@ -293,6 +293,24 @@ class TestGPT2:
         np.testing.assert_allclose(losses("gpipe"), losses("1f1b"),
                                    rtol=2e-2)
 
+    def test_pipe_1f1b_composes_with_grad_accum(self):
+        """grad_accum scans the custom_vjp 1F1B loss over accumulation
+        microbatches — the composition must train with finite loss and
+        match the accum=1 trajectory (same total batch, same math)."""
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        mesh = build_mesh(MeshConfig(data=2, pipe=2), jax.devices()[:4])
+
+        def losses(accum):
+            wl = get_workload(
+                "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+                grad_accum_steps=accum, mesh=mesh, pipe_schedule="1f1b",
+            )
+            return [m["loss"] for m in run_steps(wl, mesh, 2)[1]]
+
+        np.testing.assert_allclose(losses(1), losses(2), rtol=2e-2)
+
     def test_pipeline_stage_params_sharded_over_pipe(self):
         from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
         from distributed_tensorflow_tpu.models.gpt2 import GPT2Config

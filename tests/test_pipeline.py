@@ -186,15 +186,19 @@ class TestPipeline:
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-4, atol=1e-5)
 
-    def test_1f1b_bounded_stash_memory(self):
-        """1F1B's live set is the depth-S input ring, not GPipe's O(M) tick
-        stash: compiled temp memory at M=8, S=2 must be strictly smaller."""
-        mesh = build_mesh(MeshConfig(pipe=2), jax.devices()[:2])
+    @pytest.mark.parametrize("n_stages", [2, 4])
+    def test_1f1b_bounded_stash_memory(self, n_stages):
+        """1F1B's live set is the depth-(2S-1) input ring, not GPipe's
+        O(M) tick stash: compiled temp memory at M=16 must be strictly
+        smaller, at pipe=2 AND at the deeper pipe=4 (the config class the
+        schedule exists for)."""
+        mesh = build_mesh(MeshConfig(pipe=n_stages),
+                          jax.devices()[:n_stages])
         # Activation-dominated shapes (big microbatch, small params): the
         # schedules differ in activation stashing, not in the param-grad
         # accumulators both must hold.
         dim, M, mb = 64, 16, 128
-        stages = make_stages(2, dim=dim)
+        stages = make_stages(n_stages, dim=dim)
         stacked = stack_stage_params(stages)
         rng = np.random.RandomState(5)
         x = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))

@@ -8,6 +8,46 @@ import sys
 from typing import Dict, List, Optional, Sequence
 
 
+def stream_fed_losses(wl, mesh, *, steps=2, total_steps=4, seed=1):
+    """Tier-c feeding contract shared by the multiprocess worker scripts:
+    train ``steps`` steps on IDENTICAL global batches on every host — each
+    host generates the FULL stream (shard override 1/0) and contributes
+    only the rows its devices own per ``host_batch_layout`` (a replicated
+    batch dim: the whole batch; a data-sharded dim: this process's slice).
+    Returns the per-step host losses."""
+    import jax
+
+    from distributed_tensorflow_tpu.data.pipeline import (
+        host_batch_layout,
+        set_stream_shard_override,
+    )
+    from distributed_tensorflow_tpu.train_lib import build_state_and_step
+    from distributed_tensorflow_tpu.training import FP32
+
+    state, _, step, batch_sh = build_state_and_step(
+        wl, mesh, precision=FP32, total_steps=total_steps)
+    bsh = batch_sh[wl.example_key]
+    host_bs, _, idx = host_batch_layout(bsh, wl.batch_size)
+    set_stream_shard_override(1, 0)
+    try:
+        stream = wl.data_fn(wl.batch_size)
+        losses = []
+        rng = jax.random.key(seed)
+        for i in range(steps):
+            full = next(stream)
+            lo = idx * host_bs
+            batch = {
+                k: jax.make_array_from_process_local_data(
+                    bsh, v[lo:lo + host_bs])
+                for k, v in full.items()
+            }
+            state, m = step(state, batch, jax.random.fold_in(rng, i))
+            losses.append(float(m["loss"]))
+    finally:
+        set_stream_shard_override(None)
+    return losses
+
+
 def free_ports(n: int) -> List[int]:
     """Allocate ``n`` distinct free localhost ports.
 

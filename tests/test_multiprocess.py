@@ -273,6 +273,70 @@ def test_two_process_hybrid_dcn_mesh_training(tmp_path):
         assert f"HYBRID_OK {i}" in out, out[-2000:]
 
 
+PIPE_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import AxisType, Mesh
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.models import get_workload
+from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+resolver = cluster_lib.resolve()
+server = cluster_lib.Server.from_resolver(resolver)
+assert jax.process_count() == 2 and jax.device_count() == 8
+
+# Manual mesh with `pipe` as the SLOWEST axis: pipe rank 0 = process 0's
+# devices, pipe rank 1 = process 1's — every pipeline stage hand-off
+# (ppermute over `pipe`) crosses the process boundary for real.
+dev = np.array(jax.devices()).reshape(2, 1, 1, 1, 1, 4)
+mesh = Mesh(dev, ("pipe", "fsdp", "tensor", "context", "expert", "data"),
+            axis_types=(AxisType.Auto,) * 6)
+for k in range(2):
+    owners = {d.process_index for d in dev[k].ravel()}
+    assert owners == {k}, (k, owners)
+
+
+from tests.helpers import stream_fed_losses
+
+
+def run2(schedule):
+    wl = get_workload(
+        "gpt2", config=GPT2Config.tiny(), batch_size=8, seq_len=32,
+        grad_accum_steps=1, mesh=mesh, pipe_schedule=schedule,
+    )
+    return stream_fed_losses(wl, mesh)
+
+
+losses_gpipe = run2("gpipe")
+losses_1f1b = run2("1f1b")
+assert np.isfinite(losses_gpipe).all() and np.isfinite(losses_1f1b).all()
+# Same math, different schedule — across a REAL process boundary.
+np.testing.assert_allclose(losses_gpipe, losses_1f1b, rtol=1e-4)
+
+server.shutdown()
+print("PIPE_MP_OK", jax.process_index(), losses_1f1b, flush=True)
+os._exit(0)
+"""
+
+
+def test_two_process_pipeline_pipe_axis(tmp_path):
+    """Pipeline tier-c: the `pipe` axis spans 2 processes (every GPipe/1F1B
+    stage hand-off ppermute crosses the process boundary); both schedules
+    train GPT-2 with matching losses."""
+    from tests.helpers import join_workers, spawn_worker_cluster
+
+    procs = spawn_worker_cluster(PIPE_SCRIPT, 2)
+    outs = join_workers(procs, timeout=420, fail=pytest.fail)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-4000:]}"
+        assert f"PIPE_MP_OK {i}" in out, out[-2000:]
+
+
 RING_SCRIPT = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -282,15 +346,8 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 from distributed_tensorflow_tpu import cluster as cluster_lib
-from distributed_tensorflow_tpu.data.pipeline import (
-    host_batch_layout,
-    make_global_batches,
-    set_stream_shard_override,
-)
 from distributed_tensorflow_tpu.models import get_workload
 from distributed_tensorflow_tpu.models.bert import BertConfig
-from distributed_tensorflow_tpu.train_lib import build_state_and_step
-from distributed_tensorflow_tpu.training import FP32
 
 resolver = cluster_lib.resolve()
 server = cluster_lib.Server.from_resolver(resolver)
@@ -303,33 +360,13 @@ owners = [d.process_index for d in ring_mesh.devices.ravel()]
 assert len(set(owners)) == 2, owners
 
 
+from tests.helpers import stream_fed_losses
+
+
 def run2(mesh):
     wl = get_workload("bert", config=BertConfig.tiny(dtype=np.float32),
                       batch_size=8, seq_len=64, mesh=mesh)
-    state, _, step, batch_sh = build_state_and_step(
-        wl, mesh, precision=FP32, total_steps=4)
-    bsh = batch_sh[wl.example_key]
-    # Feed IDENTICAL global batches to both mesh layouts: every host
-    # generates the full stream (shard override 1/0) and contributes the
-    # rows its devices own per the batch layout (context-only mesh: the
-    # whole replicated batch; data mesh: this process's half).
-    host_bs, n_shards, idx = host_batch_layout(bsh, wl.batch_size)
-    set_stream_shard_override(1, 0)
-    stream = wl.data_fn(wl.batch_size)
-    losses = []
-    rng = jax.random.key(1)
-    for i in range(2):
-        full = next(stream)
-        lo = idx * host_bs
-        batch = {
-            k: jax.make_array_from_process_local_data(
-                bsh, v[lo:lo + host_bs])
-            for k, v in full.items()
-        }
-        state, m = step(state, batch, jax.random.fold_in(rng, i))
-        losses.append(float(m["loss"]))
-    set_stream_shard_override(None)
-    return losses
+    return stream_fed_losses(wl, mesh)
 
 losses_ring = run2(ring_mesh)
 losses_flat = run2(cluster_lib.build_mesh(cluster_lib.MeshConfig(data=8)))

@@ -47,6 +47,10 @@ def main(argv=None):
     ap.add_argument("--emb_dim", type=int, default=None,
                     help="wide_deep: embedding row width (row bytes = "
                          "emb_dim * itemsize vs the ~512B HBM granule)")
+    ap.add_argument("--n_positions", type=int, default=None,
+                    help="gpt2: position-embedding length (raise above the "
+                         "preset's 1024 for the long-context ladder, e.g. "
+                         "--n_positions=8192 --seq_len=8192)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--windows", type=int, default=3,
@@ -74,6 +78,13 @@ def main(argv=None):
         kw["table_dtype"] = args.table_dtype
     if args.emb_dim is not None:
         kw["emb_dim"] = args.emb_dim
+    if args.n_positions is not None:
+        import dataclasses
+
+        from distributed_tensorflow_tpu.models.gpt2 import GPT2Config
+
+        kw["config"] = dataclasses.replace(
+            GPT2Config.medium(), n_positions=args.n_positions)
     wl = get_workload(
         args.model,
         batch_size=args.batch_size * n_dev,

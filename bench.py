@@ -10,9 +10,15 @@ exists it is 1.0 by definition.
 ``--input=loader`` times the SAME training loop fed by the real input path
 (staged record file -> native C++ loader -> DevicePrefetchIterator) instead
 of one cached device batch — the end-to-end number including input
-(SURVEY.md §8: the input pipeline is the usual scaling killer).  The driver
-runs the default (cached) mode; the loader mode exists so BASELINE.md can
-report both and their gap.
+(SURVEY.md §8: the input pipeline is the usual scaling killer).
+``--input=both`` measures cached then loader in ONE process (same compiled
+step, same host state) and reports both plus ``gap_pct`` — the input
+pipeline's toll on the hot loop — so BASELINE.md gets the comparison from a
+single run instead of two runs with different compilation/host noise.
+
+The hot loop here mirrors the async-loop contract: the step folds the step
+counter into a constant base key on device (``in_step_rng`` — no host-side
+``fold_in``/``split`` per step), so the timed window contains dispatch only.
 """
 
 import argparse
@@ -24,9 +30,86 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
 
+def _make_data_iter(mode, flags, wl, sh, host_bs):
+    """Returns (iterator, prefetch_iterator_or_None) for one input mode."""
+    if mode == "loader":
+        from distributed_tensorflow_tpu.data.pipeline import (
+            DevicePrefetchIterator,
+        )
+        from distributed_tensorflow_tpu.data.records import (
+            record_data_fn,
+            resolve_or_stage,
+        )
+
+        paths = resolve_or_stage(flags.data_dir, wl, flags.records)
+        prefetch = DevicePrefetchIterator(
+            record_data_fn(paths, wl, num_threads=2, prefetch=4)(host_bs),
+            sh, prefetch=2,
+        )
+        return iter(prefetch), prefetch
+    import itertools
+
+    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
+
+    it = make_global_batches(wl.data_fn(host_bs), sh)
+    return itertools.repeat(next(it)), None  # infinite cached batch
+
+
+def _measure(mode, flags, wl, sh, host_bs, state, train_step, rng,
+             warmup, iters, windows, n_dev):
+    """Times one input mode; returns (state, median, rates, prefetch_stats).
+
+    The base ``rng`` is passed to every step unchanged — the compiled step
+    folds ``state.step`` in on device (async-loop contract), so the host
+    does zero per-step RNG work and the dispatch loop stays sync-free.
+    """
+    data_iter, prefetch = _make_data_iter(mode, flags, wl, sh, host_bs)
+    try:
+        for _ in range(warmup):
+            state, m = train_step(state, next(data_iter), rng)
+        # Fence with a host transfer, not block_until_ready: through the
+        # axon tunnel block_until_ready returns before execution finishes
+        # (measured: 50 chained 4096^3 matmuls "complete" in 0.1 ms), so
+        # only pulling a value bounds the async queue.  A scalar keeps the
+        # transfer itself out of the measurement.
+        import jax
+
+        jax.device_get(m["loss"])
+        jax.device_get(state.step)  # fence covers the param update too
+
+        # Median of N independently-fenced windows, with spread.  One timed
+        # sample per round made cross-round deltas indistinguishable from
+        # host noise (VERDICT r4 weak #1: 2343 vs 2209, no error bars).
+        rates = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, m = train_step(state, next(data_iter), rng)
+            jax.device_get(m["loss"])
+            if flags.fence == "full":
+                jax.device_get(state.step)  # include the param update
+            dt = time.perf_counter() - t0
+            rates.append(wl.batch_size * iters / dt / n_dev)
+        stats = prefetch.stats() if prefetch is not None else None
+    finally:
+        if prefetch is not None:
+            prefetch.close()
+    return state, statistics.median(rates), rates, stats
+
+
+def _spread(rates):
+    return {
+        "n": len(rates),
+        "min": round(min(rates), 2),
+        "max": round(max(rates), 2),
+        "windows": [round(r, 2) for r in rates],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--input", choices=("cached", "loader"), default="cached")
+    ap.add_argument("--input", choices=("cached", "loader", "both"),
+                    default="cached")
     ap.add_argument("--records", type=int, default=1024,
                     help="loader mode: records to stage (reused if present)")
     ap.add_argument("--data_dir", default="/tmp/dtt_bench_data",
@@ -43,9 +126,9 @@ def main(argv=None):
                          "Exists to attribute cross-round deltas.")
     flags = ap.parse_args(argv)
     import jax
-    import jax.numpy as jnp
 
     from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.data import per_host_batch_size
     from distributed_tensorflow_tpu.models import get_workload
     from distributed_tensorflow_tpu.train_lib import build_state_and_step
     from distributed_tensorflow_tpu.training import BF16
@@ -59,9 +142,6 @@ def main(argv=None):
     else:
         batch, image, stages, warmup, iters = 16, 64, (1, 1, 1, 1), 1, 3
 
-    from distributed_tensorflow_tpu.data import per_host_batch_size
-    from distributed_tensorflow_tpu.data.pipeline import make_global_batches
-
     n_dev = jax.device_count()
     mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(data=n_dev))
     wl = get_workload(
@@ -71,68 +151,32 @@ def main(argv=None):
         stage_sizes=stages,
     )
     windows = max(1, flags.windows)
+    modes = ("cached", "loader") if flags.input == "both" else (flags.input,)
     state, state_sh, train_step, batch_sh = build_state_and_step(
-        wl, mesh, precision=BF16, total_steps=warmup + iters * windows,
+        wl, mesh, precision=BF16,
+        total_steps=len(modes) * (warmup + iters * windows),
     )
     sh = batch_sh[wl.example_key]
     host_bs = per_host_batch_size(wl.batch_size)
-    if flags.input == "loader":
-        from distributed_tensorflow_tpu.data.pipeline import (
-            DevicePrefetchIterator,
-        )
-        from distributed_tensorflow_tpu.data.records import (
-            record_data_fn,
-            resolve_or_stage,
-        )
-
-        paths = resolve_or_stage(flags.data_dir, wl, flags.records)
-        data_iter = iter(DevicePrefetchIterator(
-            record_data_fn(paths, wl, num_threads=2, prefetch=4)(host_bs),
-            sh, prefetch=2,
-        ))
-    else:
-        import itertools
-
-        it = make_global_batches(wl.data_fn(host_bs), sh)
-        data_iter = itertools.repeat(next(it))  # infinite cached batch
 
     rng = jax.random.key(0)
-    for i in range(warmup):
-        state, m = train_step(state, next(data_iter),
-                              jax.random.fold_in(rng, i))
-    # Fence with a host transfer, not block_until_ready: through the axon
-    # tunnel block_until_ready returns before execution finishes (measured:
-    # 50 chained 4096^3 matmuls "complete" in 0.1 ms), so only pulling a
-    # value bounds the async queue.  A scalar keeps the transfer itself
-    # out of the measurement.
-    jax.device_get(m["loss"])
-    jax.device_get(state.step)  # fence covers the param update too (ADVICE r3)
+    results = {}
+    for mode in modes:
+        state, median, rates, pstats = _measure(
+            mode, flags, wl, sh, host_bs, state, train_step, rng,
+            warmup, iters, windows, n_dev,
+        )
+        results[mode] = {"value": median, "rates": rates, "prefetch": pstats}
 
-    # Median of N independently-fenced windows, with spread.  One timed
-    # sample per round made cross-round deltas indistinguishable from host
-    # noise (VERDICT r4 weak #1: 2343 vs 2209 with no error bars).
-    rates = []
-    step_idx = warmup
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, m = train_step(state, next(data_iter),
-                                  jax.random.fold_in(rng, step_idx))
-            step_idx += 1
-        jax.device_get(m["loss"])
-        if flags.fence == "full":
-            jax.device_get(state.step)  # fence covers the param update too
-        dt = time.perf_counter() - t0
-        rates.append(wl.batch_size * iters / dt / n_dev)
-
-    per_chip = statistics.median(rates)
+    primary = "cached" if flags.input == "both" else flags.input
+    per_chip = results[primary]["value"]
 
     # Own-baseline ladder: first recorded real-TPU value is the 1.0 reference
     # point.  CPU smoke runs use a different (tiny) config, so they neither
     # read nor write the baseline and report under a distinct metric name.
     baseline_file = os.path.join(os.path.dirname(__file__), ".bench_baseline.json")
     vs_baseline = 1.0
-    if on_tpu and flags.input == "loader":
+    if on_tpu and primary == "loader":
         # loader-fed mode compares against the cached anchor (same units)
         # but never writes it — the anchor stays the cached-batch number.
         if os.path.exists(baseline_file):
@@ -159,22 +203,36 @@ def main(argv=None):
 
     if on_tpu:
         metric = "resnet50_images_per_sec_per_chip"
-        if flags.input == "loader":
+        if primary == "loader":
             metric += "_loader_fed"
     else:
         metric = "resnet_tiny_cpu_smoke_images_per_sec"
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
-        "spread": {
-            "n": len(rates),
-            "min": round(min(rates), 2),
-            "max": round(max(rates), 2),
-            "windows": [round(r, 2) for r in rates],
-        },
-    }))
+        "spread": _spread(results[primary]["rates"]),
+    }
+    if results.get(primary, {}).get("prefetch"):
+        out["prefetch"] = {
+            k: round(v, 4) if isinstance(v, float) else v
+            for k, v in results[primary]["prefetch"].items()
+        }
+    if flags.input == "both":
+        cached, loader = results["cached"]["value"], results["loader"]["value"]
+        out["loader"] = {
+            "value": round(loader, 2),
+            "spread": _spread(results["loader"]["rates"]),
+            "prefetch": {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in (results["loader"]["prefetch"] or {}).items()
+            },
+        }
+        # Positive gap = the input pipeline costs throughput vs the cached
+        # upper bound; ~0 = transfer fully overlapped with compute.
+        out["gap_pct"] = round((cached - loader) / cached * 100.0, 2)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -28,7 +28,21 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
-from jax.sharding import AxisType, Mesh
+from jax.sharding import Mesh
+
+try:  # jax >= 0.5: explicit axis types on Mesh
+    from jax.sharding import AxisType
+except ImportError:  # jax 0.4.x: no AxisType; plain Mesh behaves as Auto
+    AxisType = None
+
+
+def _make_mesh(dev_array: np.ndarray) -> Mesh:
+    """Mesh with Auto axis types where the jax version supports them."""
+    if AxisType is None:
+        return Mesh(dev_array, MESH_AXES)
+    return Mesh(
+        dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
+    )
 
 # Order matters: outer→inner. ``data`` outermost maps replicas across hosts
 # (gradient allreduce rides DCN between slices at worst), while ``tensor`` and
@@ -109,9 +123,7 @@ def build_mesh(
         except (ValueError, NotImplementedError):
             # CPU test meshes and odd shapes: fall back to row-major layout.
             dev_array = np.array(devices).reshape(shape)
-    return Mesh(
-        dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
-    )
+    return _make_mesh(dev_array)
 
 
 def build_hybrid_mesh(
@@ -192,9 +204,7 @@ def build_hybrid_mesh(
             part = np.array(devices[s * per:(s + 1) * per]).reshape(shape)
             groups.append(part)
         dev_array = np.concatenate(groups, axis=data_ax)
-    return Mesh(
-        dev_array, MESH_AXES, axis_types=(AxisType.Auto,) * len(MESH_AXES)
-    )
+    return _make_mesh(dev_array)
 
 
 def single_axis_mesh(

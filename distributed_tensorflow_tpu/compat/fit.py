@@ -139,9 +139,14 @@ class _CallbackBridge(Hook):
             if callable(fn):
                 fn(*args)
 
+    def on_metrics(self, loop, metrics_step, metrics):
+        # Deferred-metrics delivery (async-loop contract): values arrive one
+        # metrics_every interval after the step that produced them, plus a
+        # final flush when the epoch's run() segment ends — so the epoch
+        # mean always includes the epoch's last interval.
+        self.epoch_mean.update(metrics)
+
     def after_step(self, loop, step, metrics):
-        if metrics is not None:
-            self.epoch_mean.update(metrics)
         self._dispatch("on_train_batch_end", step - self.epoch_start_step,
                        dict(metrics) if metrics else {})
         if self.model.stop_training:

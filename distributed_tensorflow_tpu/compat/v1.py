@@ -330,6 +330,12 @@ class MonitoredTrainingSession:
         fully async on device, the same throttling as ``TrainLoop``, whose
         ``run_one_step`` this drives); with fetches, returns the TF-shaped
         list ``[metrics, *fetched_values]``.
+
+        Async-loop contract: the metrics dict returned at a boundary holds
+        the values of the PREVIOUS ``metrics_every`` boundary — the fetch
+        for the current boundary is started asynchronously and consumed one
+        interval later (or at ``close()``), so ``run()`` never blocks on a
+        device→host copy.  The first boundary therefore returns None.
         """
         if self._loop._stop:
             raise RuntimeError(
@@ -373,6 +379,9 @@ class MonitoredTrainingSession:
         if self._closed:
             return
         self._closed = True
+        # Drain the in-flight deferred metrics fetch so the final interval
+        # reaches hooks (TF1: session close flushed pending summaries).
+        self._loop.flush_metrics()
         for h in self._loop.hooks:
             h.end(self._loop, self._step)
         if self._manager is not None:

@@ -14,6 +14,7 @@ from distributed_tensorflow_tpu.obs.tensorboard import (
     MetricsFileWriter,
     TensorBoardHook,
 )
+from distributed_tensorflow_tpu.obs.prefetch import PrefetchMonitorHook
 from distributed_tensorflow_tpu.obs.profiling import (
     Profile,
     start_profiler_server,
@@ -21,6 +22,7 @@ from distributed_tensorflow_tpu.obs.profiling import (
 
 __all__ = [
     "MetricsFileWriter",
+    "PrefetchMonitorHook",
     "Profile",
     "TensorBoardHook",
     "start_profiler_server",

@@ -48,12 +48,13 @@ class TensorBoardHook(Hook):
                 tag = f"train/{k}"
             self._writer.add_scalar(tag, v, global_step=step)
 
-    def after_step(self, loop, step, metrics: Optional[Dict[str, float]]):
-        # metrics is non-None only at the loop's metrics_every cadence; write
-        # every point it gives us (gating again on every_steps here would
-        # silently drop points whenever the two cadences don't align).
-        if metrics is not None:
-            self.write(step, metrics)
+    def on_metrics(self, loop, metrics_step, metrics):
+        # Deferred-metrics delivery channel: metrics_step is the step the
+        # values belong to (delivery happens one metrics_every interval
+        # later), so scalars land on the correct x-axis point.  Writing
+        # every delivered point rather than re-gating on every_steps keeps
+        # unaligned cadences from silently dropping points.
+        self.write(metrics_step, metrics)
 
     def end(self, loop, step):
         if self._writer is not None:
@@ -82,9 +83,8 @@ class MetricsFileWriter(Hook):
             {"step": step, "time": time.time(), **metrics}
         ) + "\n")
 
-    def after_step(self, loop, step, metrics):
-        if metrics is not None:
-            self.write(step, metrics)
+    def on_metrics(self, loop, metrics_step, metrics):
+        self.write(metrics_step, metrics)  # true step, not delivery step
 
     def end(self, loop, step):
         if self._f is not None:

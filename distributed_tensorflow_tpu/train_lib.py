@@ -42,6 +42,7 @@ from distributed_tensorflow_tpu.training import (
     TrainState,
     make_eval_step,
     make_train_step,
+    mark_in_step_rng,
 )
 
 logger = logging.getLogger(__name__)
@@ -248,15 +249,18 @@ def build_state_and_step(
         clip_grad_norm=workload.clip_grad_norm,
         jit=False,
         stateful=workload.stateful,
+        # Async-loop contract: the step folds state.step into a constant
+        # base key on device, so the loop never splits keys host-side.
+        in_step_rng=True,
     )
     bsh = batch_sharding(mesh)
     batch_shardings = {k: bsh for k in workload.init_batch}
-    train_step = jax.jit(
+    train_step = mark_in_step_rng(jax.jit(
         raw_step,
         in_shardings=(state_shardings, batch_shardings, NamedSharding(mesh, P())),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
-    )
+    ), True)
     return state, state_shardings, train_step, batch_shardings
 
 
@@ -438,7 +442,13 @@ def run(args: TrainArgs) -> Dict[str, Any]:
     data_iter = DevicePrefetchIterator(host_iter, bsh, prefetch=2)
 
     # 5. Hooks.
-    hooks = [LoggingHook(every_steps=args.log_every), NanHook()]
+    from distributed_tensorflow_tpu.obs import PrefetchMonitorHook
+
+    hooks = [
+        LoggingHook(every_steps=args.log_every),
+        NanHook(),
+        PrefetchMonitorHook(data_iter, every_steps=max(args.log_every, 1)),
+    ]
     if jax.process_count() > 1:
         # Peer-liveness fail-fast (MWMS check-health equivalent, SURVEY
         # §6.3): a dead peer raises at the next step boundary instead of

@@ -10,7 +10,12 @@ from distributed_tensorflow_tpu.training.loop import (
     TrainLoop,
 )
 from distributed_tensorflow_tpu.training.metrics import RunningMean, ThroughputMeter
-from distributed_tensorflow_tpu.training.step import make_eval_step, make_train_step
+from distributed_tensorflow_tpu.training.step import (
+    make_eval_step,
+    make_train_step,
+    mark_in_step_rng,
+    shard_train_step,
+)
 from distributed_tensorflow_tpu.training.train_state import (
     BF16,
     FP32,
@@ -34,4 +39,6 @@ __all__ = [
     "TrainState",
     "make_eval_step",
     "make_train_step",
+    "mark_in_step_rng",
+    "shard_train_step",
 ]

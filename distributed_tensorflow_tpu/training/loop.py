@@ -6,16 +6,29 @@ basic_session_run_hooks.py — ``LoggingTensorHook``:169, ``StepCounterHook``
 :674, ``CheckpointSaverHook``:524, ``NanTensorHook``:761 — SURVEY.md §6.5)
 and TF2 Keras ``Model.fit``'s callback loop.  The loop is deliberately thin:
 the heavy lifting happens inside the compiled step; hooks observe at step
-boundaries on the host.  Device→host transfers of metrics are throttled
-(``log_every``) so the loop never blocks the device pipeline every step —
-the TPU equivalent of keeping the feed queue full.
+boundaries on the host.
+
+The hot path is fully asynchronous (the async-loop contract):
+
+- **RNG**: with an in-step-RNG train step (``make_train_step(...,
+  in_step_rng=True)``, the ``train_lib`` default) the loop passes the SAME
+  base key every step and the compiled program folds ``state.step`` into it
+  — ``run_one_step`` is pure dispatch, no host-side ``random.split``.
+  Steps built without the flag keep the legacy per-step host split.
+- **Metrics**: never pulled synchronously.  At step N (a ``metrics_every``
+  boundary) the loop starts ``copy_to_host_async()`` on the metrics pytree;
+  the transfer is consumed — one batched ``device_get`` over already-landed
+  buffers — at step N+``metrics_every``.  Hooks therefore observe step-N
+  metrics one interval late; ``loop.last_metrics_step`` names the step the
+  delivered values belong to, and ``Hook.on_metrics`` receives it directly.
+  ``run`` flushes the final pending interval before hooks ``end``.
 """
 
 from __future__ import annotations
 
 import logging
 import math
-import time
+import sys
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
@@ -29,13 +42,26 @@ PyTree = Any
 
 
 class Hook:
-    """Step-boundary observer (SessionRunHook equivalent)."""
+    """Step-boundary observer (SessionRunHook equivalent).
+
+    ``after_step`` fires every step; its ``metrics`` argument is non-None
+    only when a deferred fetch landed this step, and then holds the metrics
+    of ``loop.last_metrics_step`` (one ``metrics_every`` interval behind —
+    the async-loop contract).  ``on_metrics`` is the value-delivery channel:
+    it receives the TRUE step the metrics belong to, including the final
+    flush that ``run``/``flush_metrics`` performs after the last step (when
+    ``after_step`` will not fire again).
+    """
 
     def begin(self, loop: "TrainLoop") -> None:  # noqa: D401
         pass
 
     def after_step(self, loop: "TrainLoop", step: int,
                    metrics: Optional[Dict[str, float]]) -> None:
+        pass
+
+    def on_metrics(self, loop: "TrainLoop", metrics_step: int,
+                   metrics: Dict[str, float]) -> None:
         pass
 
     def end(self, loop: "TrainLoop", step: int) -> None:
@@ -48,14 +74,20 @@ class LoggingHook(Hook):
     def __init__(self, every_steps: int = 100):
         self.every_steps = every_steps
         self._mean = RunningMean()
+        # Constructed here (not in begin) so a hook driven through
+        # ``after_step`` without a prior ``begin`` (compat surfaces that
+        # drive ``run_one_step`` directly) never hits an AttributeError;
+        # ``begin`` re-arms it with the loop's real examples_per_step.
+        self._meter = ThroughputMeter(0)
 
     def begin(self, loop):
         self._meter = ThroughputMeter(loop.examples_per_step)
 
+    def on_metrics(self, loop, metrics_step, metrics):
+        self._mean.update(metrics)
+
     def after_step(self, loop, step, metrics):
         self._meter.update()
-        if metrics is not None:
-            self._mean.update(metrics)
         if step % self.every_steps == 0 and step > 0:
             m = {**self._mean.report_and_reset(), **self._meter.report()}
             msg = ", ".join(f"{k}={v:.4g}" for k, v in sorted(m.items()))
@@ -64,24 +96,35 @@ class LoggingHook(Hook):
 
 
 class NanHook(Hook):
-    """Stop (or raise) on non-finite loss (NanTensorHook equivalent)."""
+    """Stop (or raise) on non-finite loss (NanTensorHook equivalent).
+
+    Deferred-metrics semantics: the check runs when the values LAND (one
+    ``metrics_every`` interval after the step that produced them), so up to
+    ``metrics_every`` further steps may have executed — they are discarded
+    on restart anyway, and the error names the step that actually NaN'd.
+    """
 
     def __init__(self, fail_on_nan: bool = True):
         self.fail_on_nan = fail_on_nan
 
-    def after_step(self, loop, step, metrics):
-        if metrics is None:
-            return
+    def on_metrics(self, loop, metrics_step, metrics):
         loss = metrics.get("loss")
         if loss is not None and not math.isfinite(loss):
             if self.fail_on_nan:
-                raise FloatingPointError(f"Non-finite loss at step {step}: {loss}")
-            logger.error("Non-finite loss at step %d; requesting stop", step)
+                raise FloatingPointError(
+                    f"Non-finite loss at step {metrics_step}: {loss}")
+            logger.error("Non-finite loss at step %d; requesting stop",
+                         metrics_step)
             loop.request_stop()
 
 
 class CheckpointHook(Hook):
-    """CheckpointSaverHook equivalent over the orbax manager."""
+    """CheckpointSaverHook equivalent over the orbax manager.
+
+    Unaffected by the deferred-metrics lag: it saves ``loop.state`` on the
+    true step cadence (the state at step N IS step N's state; only metric
+    *values* arrive an interval late).
+    """
 
     def __init__(self, manager, every_steps: int = 1000):
         self.manager = manager
@@ -125,6 +168,11 @@ class EvalHook(Hook):
     inlined: TF1 ran a separate evaluator job re-reading checkpoints; with a
     compiled eval step the cheaper TPU-native form is to evaluate in-loop at
     an interval).  Averages metrics over ``num_batches`` eval batches.
+
+    Deferred-metrics semantics: evaluation triggers on the true step cadence
+    and evaluates the CURRENT ``loop.state`` — the training-metric lag does
+    not shift what is evaluated.  The eval pull itself is blocking by
+    design (it already sits outside the hot path).
     """
 
     def __init__(self, eval_step: Callable, data_iter: Iterable,
@@ -173,8 +221,16 @@ class EvalHook(Hook):
 class TrainLoop:
     """Drives (state, batch) -> state for a fixed number of steps.
 
-    Metrics are fetched to host only every ``metrics_every`` steps; other
-    steps stay fully async on device.
+    The hot path never blocks on the device (module docstring: the
+    async-loop contract).  Metric transfers START every ``metrics_every``
+    steps and are CONSUMED one interval later; hooks see step-N values at
+    step N+``metrics_every`` with ``last_metrics_step == N``.
+
+    ``fold_rng=None`` (default) auto-detects: train steps built with
+    ``in_step_rng=True`` carry a marker attribute and receive the constant
+    base ``rng`` every call (the step folds ``state.step`` in on device);
+    unmarked steps get the legacy host-side per-step ``random.split``.
+    Pass ``fold_rng=True``/``False`` to override the detection.
     """
 
     def __init__(
@@ -187,6 +243,7 @@ class TrainLoop:
         examples_per_step: int = 0,
         metrics_every: int = 10,
         rng: Optional[jax.Array] = None,
+        fold_rng: Optional[bool] = None,
     ):
         self.train_step = train_step
         self.state = state
@@ -195,8 +252,15 @@ class TrainLoop:
         self.examples_per_step = examples_per_step
         self.metrics_every = max(1, metrics_every)
         self.rng = rng if rng is not None else jax.random.key(0)
+        self.fold_rng = fold_rng
         self.last_logged_metrics: Dict[str, float] = {}
         self.last_step_metrics: Optional[Dict[str, float]] = None
+        # Step the last delivered metrics belong to (== delivery step minus
+        # metrics_every under the deferred contract); None before the first
+        # delivery.
+        self.last_metrics_step: Optional[int] = None
+        # (step, device metrics pytree) whose host copy is in flight.
+        self._pending_metrics: Optional[tuple] = None
         self._stop = False
 
     def request_stop(self) -> None:
@@ -208,6 +272,61 @@ class TrainLoop:
         further ``run`` calls will make no progress."""
         return self._stop
 
+    # -- deferred metrics --------------------------------------------------
+
+    def _start_metrics_fetch(self, step: int, metrics: PyTree) -> None:
+        """Begin the device→host copy without blocking the dispatch loop."""
+        for leaf in jax.tree.leaves(metrics):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if callable(start):
+                start()
+        self._pending_metrics = (step, metrics)
+
+    def _consume_pending_metrics(self):
+        """(metrics_step, host dict) of the in-flight fetch, or (None, None).
+
+        One batched ``device_get`` over the whole pytree; the async copies
+        started an interval ago have normally landed, so this does not
+        drain the device pipeline.
+        """
+        if self._pending_metrics is None:
+            return None, None
+        step, tree = self._pending_metrics
+        self._pending_metrics = None
+        host_tree = jax.device_get(tree)
+        host = {k: float(np.asarray(v)) for k, v in host_tree.items()}
+        return step, host
+
+    def _deliver(self, metrics_step: int, host: Dict[str, float]) -> None:
+        self.last_metrics_step = metrics_step
+        self.last_step_metrics = host
+        for h in self.hooks:
+            h.on_metrics(self, metrics_step, host)
+
+    def flush_metrics(self) -> Optional[Dict[str, float]]:
+        """Consume the in-flight metrics fetch immediately (end of a run
+        segment / session close — ``after_step`` will not fire again for
+        it).  Delivers through ``Hook.on_metrics`` and returns the dict."""
+        mstep, host = self._consume_pending_metrics()
+        if host is None:
+            return None
+        self._deliver(mstep, host)
+        self.last_logged_metrics.update(host)
+        return host
+
+    # -- stepping ----------------------------------------------------------
+
+    def _step_rng(self, fn) -> jax.Array:
+        fold = self.fold_rng
+        if fold is None:
+            fold = getattr(fn, "_dtt_in_step_rng", False)
+        if fold:
+            # In-step RNG: the compiled program folds state.step into the
+            # base key; the SAME array is passed every call (pure dispatch).
+            return self.rng
+        self.rng, step_rng = jax.random.split(self.rng)  # legacy compat
+        return step_rng
+
     def run_one_step(self, completed_steps: int, train_step=None) -> int:
         """One step: feed a batch, run the compiled step, drive hooks.
 
@@ -215,7 +334,9 @@ class TrainLoop:
         TF1 ``compat.v1.MonitoredTrainingSession.run`` so both loop bodies
         are the same code.  An exhausted data iterator requests stop (the
         TF1 OutOfRangeError-ends-the-session contract) and leaves the count
-        unchanged.
+        unchanged.  No host↔device synchronization happens here: RNG is
+        folded in-step (or split host-side on the legacy path), and metric
+        fetches are started asynchronously and consumed an interval later.
         """
         fn = train_step if train_step is not None else self.train_step
         try:
@@ -224,18 +345,17 @@ class TrainLoop:
             self.request_stop()
             self.last_step_metrics = None
             return completed_steps
-        self.rng, step_rng = jax.random.split(self.rng)
-        self.state, metrics = fn(self.state, batch, step_rng)
+        self.state, metrics = fn(self.state, batch, self._step_rng(fn))
         completed_steps += 1
         host_metrics = None
         if completed_steps % self.metrics_every == 0:
-            host_metrics = {
-                k: float(np.asarray(jax.device_get(v)))
-                for k, v in metrics.items()
-            }
+            mstep, host_metrics = self._consume_pending_metrics()
+            self._start_metrics_fetch(completed_steps, metrics)
+            if host_metrics is not None:
+                self._deliver(mstep, host_metrics)
+        self.last_step_metrics = host_metrics
         for h in self.hooks:
             h.after_step(self, completed_steps, host_metrics)
-        self.last_step_metrics = host_metrics
         return completed_steps
 
     def run(self, num_steps: int) -> TrainState:
@@ -249,6 +369,13 @@ class TrainLoop:
                     break
                 completed = self.run_one_step(completed)
         finally:
-            for h in self.hooks:
-                h.end(self, completed)
+            try:
+                # Only flush on the clean path: re-delivering on an already-
+                # propagating error would mask it (e.g. NanHook re-raising
+                # from inside finally).
+                if sys.exc_info()[0] is None:
+                    self.flush_metrics()
+            finally:
+                for h in self.hooks:
+                    h.end(self, completed)
         return self.state

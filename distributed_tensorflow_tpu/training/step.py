@@ -34,6 +34,17 @@ StatefulLossFn = Callable[
 ]
 
 
+def mark_in_step_rng(fn, flag: bool):
+    """Tag a step fn (raw or jitted) so ``TrainLoop`` knows whether its rng
+    argument is a per-step key (legacy) or a constant base key that the
+    compiled program folds ``state.step`` into."""
+    try:
+        fn._dtt_in_step_rng = flag
+    except AttributeError:  # exotic callables that reject attributes
+        pass
+    return fn
+
+
 def make_train_step(
     loss_fn: LossFn,
     *,
@@ -43,6 +54,7 @@ def make_train_step(
     donate: bool = True,
     jit: bool = True,
     stateful: bool = False,
+    in_step_rng: bool = False,
 ) -> Callable[[TrainState, PyTree, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the (optionally jitted) train step.
 
@@ -52,6 +64,13 @@ def make_train_step(
     shardings (``shard_train_step``) or for embedding in a larger program.
     ``stateful=True`` switches to the ``StatefulLossFn`` signature and
     threads ``state.model_state`` (e.g. batch_stats) through the step.
+
+    ``in_step_rng=True`` makes the rng argument a *base* key: the compiled
+    program derives the per-step key as ``fold_in(rng, state.step)``, so
+    the caller passes the SAME key every step — no host-side ``split`` in
+    the hot loop (the async-loop contract; ``TrainLoop`` auto-detects this
+    via a marker attribute).  The default keeps the legacy per-step-key
+    signature for existing callers.
     """
 
     def compute_grads(params, model_state, batch, rng):
@@ -72,6 +91,9 @@ def make_train_step(
         return loss, aux, grads, new_ms
 
     def step(state: TrainState, batch: PyTree, rng: jax.Array):
+        if in_step_rng:
+            # rng is a constant base key; derive this step's key on device.
+            rng = jax.random.fold_in(rng, state.step.astype(jnp.uint32))
         if grad_accum_steps == 1:
             loss, aux, grads, new_ms = compute_grads(
                 state.params, state.model_state, batch, rng
@@ -111,9 +133,11 @@ def make_train_step(
         return new_state, metrics
 
     if not jit:
-        return step
+        return mark_in_step_rng(step, in_step_rng)
     donate_argnums = (0,) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return mark_in_step_rng(
+        jax.jit(step, donate_argnums=donate_argnums), in_step_rng
+    )
 
 
 def make_eval_step(
@@ -142,10 +166,16 @@ def shard_train_step(
     TPU-natively: state shardings say where parameters live (replicated for
     pure DP, partitioned for fsdp/tensor), the batch sharding splits input
     over data axes, and XLA derives every collective from that.
+
+    The in-step-RNG marker (``make_train_step(in_step_rng=True)``) is
+    propagated onto the re-jitted step so ``TrainLoop`` keeps detecting it.
     """
-    return jax.jit(
+    jitted = jax.jit(
         train_step.__wrapped__ if hasattr(train_step, "__wrapped__") else train_step,
         in_shardings=(state_shardings, batch_sharding, NamedSharding(mesh, P())),
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,),
+    )
+    return mark_in_step_rng(
+        jitted, getattr(train_step, "_dtt_in_step_rng", False)
     )

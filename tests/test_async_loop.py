@@ -1,0 +1,289 @@
+"""Async-loop contract tests: deferred metrics, in-step RNG, prefetch.
+
+Covers the three layers of the fully-async hot loop:
+
+1. RNG — the compiled step folds ``state.step`` into a constant base key
+   (``in_step_rng=True``); ``TrainLoop`` detects the marker and passes the
+   SAME key every step (no host ``random.split`` in ``run_one_step``).
+2. Metrics — fetched asynchronously: started at boundary N, consumed and
+   delivered at boundary N + ``metrics_every``; ``flush_metrics`` drains
+   the final pending interval.
+3. Input — ``DevicePrefetchIterator``'s parallel transfer stage preserves
+   batch order, applies backpressure at ``prefetch`` depth, exports stats,
+   and joins its producer thread on ``close()``.
+"""
+
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.data.pipeline import DevicePrefetchIterator
+from distributed_tensorflow_tpu.parallel.sharding import batch_sharding
+from distributed_tensorflow_tpu.training import (
+    FP32,
+    Hook,
+    LoggingHook,
+    NanHook,
+    TrainLoop,
+    TrainState,
+    make_train_step,
+    mark_in_step_rng,
+)
+
+
+class _Recorder(Hook):
+    """Captures the two delivery channels separately."""
+
+    def __init__(self):
+        self.on_metrics_calls = []   # (metrics_step, metrics dict)
+        self.after_step_calls = []   # (step, metrics dict or None)
+
+    def on_metrics(self, loop, metrics_step, metrics):
+        self.on_metrics_calls.append((metrics_step, dict(metrics)))
+
+    def after_step(self, loop, step, metrics):
+        self.after_step_calls.append(
+            (step, None if metrics is None else dict(metrics))
+        )
+
+
+class _FakeState:
+    """Minimal state: the loop only reads ``.step``."""
+
+    def __init__(self, step):
+        self.step = jnp.asarray(step, jnp.int32)
+
+
+def _echo_step(state, batch, rng):
+    """Fake step: echoes the batch's tag into the metrics."""
+    return _FakeState(state.step + 1), {
+        "loss": jnp.float32(0.0),
+        "tag": jnp.asarray(batch["tag"], jnp.float32),
+    }
+
+
+def _tagged_batches(n=10_000):
+    for i in range(1, n + 1):
+        yield {"tag": np.float32(i)}  # batch consumed at step i carries i
+
+
+class TestDeferredMetrics:
+    def test_hook_sees_step_n_metrics_at_step_n_plus_interval(self):
+        rec = _Recorder()
+        loop = TrainLoop(
+            _echo_step, _FakeState(0), _tagged_batches(),
+            hooks=[rec], metrics_every=3,
+        )
+        loop.run(9)
+        # Delivery lags one interval: step-3 values land at step 6, step-6
+        # at step 9; the final flush delivers step 9 after the last step.
+        assert [(s, m["tag"]) for s, m in rec.on_metrics_calls] == [
+            (3, 3.0), (6, 6.0), (9, 9.0),
+        ]
+        by_step = dict(rec.after_step_calls)
+        assert by_step[3] is None            # fetch only started
+        assert by_step[6]["tag"] == 3.0      # step-3 values, one interval late
+        assert by_step[9]["tag"] == 6.0
+        assert loop.last_metrics_step == 9   # flush delivered the tail
+        assert loop.last_step_metrics["tag"] == 9.0
+        assert loop._pending_metrics is None
+
+    def test_flush_is_idempotent(self):
+        loop = TrainLoop(
+            _echo_step, _FakeState(0), _tagged_batches(), metrics_every=2,
+        )
+        loop.run(4)
+        assert loop.flush_metrics() is None  # nothing left in flight
+
+    def test_non_boundary_steps_never_block_or_deliver(self):
+        rec = _Recorder()
+        loop = TrainLoop(
+            _echo_step, _FakeState(0), _tagged_batches(),
+            hooks=[rec], metrics_every=10,
+        )
+        for step in range(1, 6):
+            assert loop.run_one_step(step - 1) == step
+        assert rec.on_metrics_calls == []
+        assert all(m is None for _, m in rec.after_step_calls)
+
+    def test_nan_error_names_the_producing_step(self):
+        def nan_at_3(state, batch, rng):
+            new = _FakeState(state.step + 1)
+            loss = float("nan") if int(new.step) == 3 else 0.0
+            return new, {"loss": jnp.float32(loss)}
+
+        loop = TrainLoop(
+            nan_at_3, _FakeState(0), _tagged_batches(),
+            hooks=[NanHook()], metrics_every=3,
+        )
+        # The NaN is produced at step 3 but its values land at step 6 —
+        # the error must still name step 3 (the deferred-metrics contract).
+        with pytest.raises(FloatingPointError, match="step 3"):
+            loop.run(9)
+
+
+class TestInStepRng:
+    def _make(self, base_key, mesh):
+        def loss_fn(params, batch, rng):
+            noise = jax.random.normal(rng, ())
+            loss = jnp.mean((params["w"] * batch["x"]) ** 2)
+            return loss, {"noise": noise}
+
+        ts = make_train_step(loss_fn, precision=FP32, in_step_rng=True)
+        assert getattr(ts, "_dtt_in_step_rng", False) is True
+        state = TrainState.create(
+            apply_fn=lambda *a: None,
+            params={"w": jnp.ones((4,))},
+            tx=optax.sgd(0.1),
+        )
+
+        def data():
+            while True:
+                yield {"x": jnp.ones((4,))}
+
+        rec = _Recorder()
+        loop = TrainLoop(
+            ts, state, data(), hooks=[rec], metrics_every=1, rng=base_key,
+        )
+        loop.run(6)
+        return [m["noise"] for _, m in rec.on_metrics_calls]
+
+    def test_same_base_key_reproduces_trajectory(self, mesh_dp):
+        a = self._make(jax.random.key(7), mesh_dp)
+        b = self._make(jax.random.key(7), mesh_dp)
+        c = self._make(jax.random.key(8), mesh_dp)
+        assert a == b                      # deterministic from the base key
+        assert len(set(a)) == len(a)       # fold_in varies the key per step
+        assert a != c                      # different base key, different run
+
+    def test_marked_step_gets_constant_base_key(self):
+        fn = mark_in_step_rng(lambda s, b, r: (s, {}), True)
+        loop = TrainLoop(fn, _FakeState(0), _tagged_batches())
+        key = loop.rng
+        assert loop._step_rng(fn) is key   # pure dispatch: no split, no copy
+        assert loop._step_rng(fn) is key
+        assert loop.rng is key
+
+    def test_unmarked_step_keeps_legacy_split(self):
+        fn = lambda s, b, r: (s, {})  # noqa: E731
+        loop = TrainLoop(fn, _FakeState(0), _tagged_batches())
+        key = loop.rng
+        out = loop._step_rng(fn)
+        assert out is not key
+        assert loop.rng is not key         # split advanced the loop key
+
+    def test_fold_rng_override_beats_detection(self):
+        fn = mark_in_step_rng(lambda s, b, r: (s, {}), True)
+        loop = TrainLoop(fn, _FakeState(0), _tagged_batches(), fold_rng=False)
+        key = loop.rng
+        assert loop._step_rng(fn) is not key
+
+
+class TestHookRobustness:
+    def test_logging_hook_after_step_before_begin(self):
+        lh = LoggingHook(every_steps=1)
+        ns = types.SimpleNamespace(last_logged_metrics={})
+        # Compat surfaces drive run_one_step without begin(); the hook must
+        # not AttributeError on its meter.
+        lh.on_metrics(ns, 1, {"loss": 2.0})
+        lh.after_step(ns, 1, {"loss": 2.0})
+        assert ns.last_logged_metrics["loss"] == 2.0
+
+
+def _host_batches(n, rows=8, cols=4, delay_s=0.0):
+    for i in range(n):
+        if delay_s:
+            time.sleep(delay_s)
+        yield {"x": np.full((rows, cols), float(i), np.float32),
+               "y": np.full((rows,), float(i), np.float32)}
+
+
+class TestDevicePrefetch:
+    def test_preserves_order_and_drains(self, mesh_dp):
+        sh = batch_sharding(mesh_dp)
+        it = DevicePrefetchIterator(_host_batches(12), sh, prefetch=3)
+        got = [float(np.asarray(b["x"])[0, 0]) for b in it]
+        assert got == [float(i) for i in range(12)]
+        with pytest.raises(StopIteration):
+            next(it)
+        s = it.stats()
+        assert s["enqueued"] == 12.0 and s["dequeued"] == 12.0
+        assert s["queue_depth"] == 0.0
+        it.close()
+
+    def test_backpressure_bounds_queue(self, mesh_dp):
+        sh = batch_sharding(mesh_dp)
+        it = DevicePrefetchIterator(_host_batches(10_000), sh, prefetch=2)
+        deadline = time.time() + 10.0
+        while it.stats()["queue_depth"] < 2.0 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # producer must now be blocked, not looping
+        s = it.stats()
+        assert s["queue_depth"] == 2.0 == s["capacity"]
+        assert s["enqueued"] - s["dequeued"] <= s["capacity"]
+        next(it)  # freeing a slot lets the producer advance
+        deadline = time.time() + 10.0
+        while it.stats()["enqueued"] < 3.0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert it.stats()["enqueued"] >= 3.0
+        assert it.stats()["producer_wait_s"] > 0.0
+        it.close()
+
+    def test_context_manager_closes_and_joins(self, mesh_dp):
+        sh = batch_sharding(mesh_dp)
+        with DevicePrefetchIterator(_host_batches(10_000), sh, prefetch=2) as it:
+            batch = next(it)
+            assert float(np.asarray(batch["x"])[0, 0]) == 0.0
+            thread = it._thread
+        assert not thread.is_alive()  # close() joined the producer
+
+    def test_close_is_reentrant(self, mesh_dp):
+        sh = batch_sharding(mesh_dp)
+        it = DevicePrefetchIterator(_host_batches(4), sh, prefetch=2)
+        next(it)
+        it.close()
+        it.close()  # second close must be a no-op, not a deadlock
+        assert not it._thread.is_alive()
+
+    def test_source_error_propagates_to_consumer(self, mesh_dp):
+        sh = batch_sharding(mesh_dp)
+
+        def bad():
+            yield {"x": np.zeros((8, 4), np.float32)}
+            raise ValueError("source exploded")
+
+        it = DevicePrefetchIterator(bad(), sh, prefetch=2)
+        next(it)
+        with pytest.raises(ValueError, match="source exploded"):
+            while True:
+                next(it)
+        it.close()
+
+    def test_transfer_stage_runs_keys_concurrently(self, mesh_dp):
+        """Both keys of one batch transfer on the pool, in submission order."""
+        sh = batch_sharding(mesh_dp)
+        seen = []
+        orig = DevicePrefetchIterator._transfer_one
+
+        def spy(self, value):
+            seen.append(threading.current_thread().name)
+            return orig(self, value)
+
+        try:
+            DevicePrefetchIterator._transfer_one = spy
+            it = DevicePrefetchIterator(
+                _host_batches(3), sh, prefetch=2, transfer_workers=2,
+            )
+            out = list(it)
+            it.close()
+        finally:
+            DevicePrefetchIterator._transfer_one = orig
+        assert len(out) == 3
+        assert len(seen) == 6  # 3 batches x 2 keys, each through the pool
+        assert all(n.startswith("dtt-transfer") for n in seen)

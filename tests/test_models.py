@@ -24,10 +24,11 @@ def run_steps(workload, mesh, n_steps, *, precision=FP32, grad_accum=1):
     host_iter = workload.data_fn(per_host_batch_size(workload.batch_size))
     sh = batch_sh[workload.example_key]
     data = make_global_batches(host_iter, sh)
+    # Constant base key: the step folds state.step in on device
+    # (build_state_and_step builds in_step_rng=True steps).
     rng = jax.random.key(1)
     metrics_hist = []
     for i, batch in zip(range(n_steps), data):
-        rng = jax.random.fold_in(rng, i)
         state, metrics = train_step(state, batch, rng)
         metrics_hist.append({k: float(v) for k, v in metrics.items()})
     return state, metrics_hist

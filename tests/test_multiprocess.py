@@ -280,7 +280,11 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
-from jax.sharding import AxisType, Mesh
+from jax.sharding import Mesh
+try:
+    from jax.sharding import AxisType
+except ImportError:
+    AxisType = None
 
 from distributed_tensorflow_tpu import cluster as cluster_lib
 from distributed_tensorflow_tpu.models import get_workload
@@ -294,8 +298,11 @@ assert jax.process_count() == 2 and jax.device_count() == 8
 # devices, pipe rank 1 = process 1's — every pipeline stage hand-off
 # (ppermute over `pipe`) crosses the process boundary for real.
 dev = np.array(jax.devices()).reshape(2, 1, 1, 1, 1, 4)
-mesh = Mesh(dev, ("pipe", "fsdp", "tensor", "context", "expert", "data"),
-            axis_types=(AxisType.Auto,) * 6)
+axes = ("pipe", "fsdp", "tensor", "context", "expert", "data")
+if AxisType is None:
+    mesh = Mesh(dev, axes)
+else:
+    mesh = Mesh(dev, axes, axis_types=(AxisType.Auto,) * 6)
 for k in range(2):
     owners = {d.process_index for d in dev[k].ravel()}
     assert owners == {k}, (k, owners)

@@ -106,8 +106,56 @@ def _spread(rates):
     }
 
 
+def _serve_bench(flags):
+    """``--mode=serve``: tokens/sec + latency percentiles through the full
+    serve stack (checkpoint/fresh-init -> KV-cache decode -> dynamic
+    batcher), one JSON line like the train bench."""
+    import jax
+
+    from distributed_tensorflow_tpu.serve import ServeArgs, run_serve
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # TPU serves the paper's GPT-2-medium; CPU smoke serves the test config
+    # with a short horizon so the line still prints quickly.
+    if on_tpu:
+        sargs = ServeArgs(model="gpt2", steps=max(64, flags.serve_requests),
+                          prompt_len=64, max_new_tokens=64,
+                          checkpoint_dir=flags.checkpoint_dir)
+    else:
+        sargs = ServeArgs(model="gpt2", preset="tiny",
+                          steps=flags.serve_requests or 16,
+                          prompt_len=8, max_new_tokens=8,
+                          checkpoint_dir=flags.checkpoint_dir)
+    result = run_serve(sargs)
+    metric = ("gpt2_serve_tokens_per_sec" if on_tpu
+              else "gpt2_tiny_cpu_smoke_serve_tokens_per_sec")
+    out = {
+        "metric": metric,
+        "value": result["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,  # serving has no ladder anchor yet (first PR)
+        "p50_latency_ms": result["p50_latency_ms"],
+        "p99_latency_ms": result["p99_latency_ms"],
+        "avg_batch_occupancy": result["avg_batch_occupancy"],
+        "requests": result["requests"],
+        "completed": result["completed"],
+        "checkpoint_step": result["checkpoint_step"],
+    }
+    print(json.dumps(out))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+                    help="train: the hot-loop images/sec bench; serve: "
+                         "tokens/sec + latency through serve/ (KV-cache "
+                         "decode + dynamic batching)")
+    ap.add_argument("--serve_requests", type=int, default=0,
+                    help="serve mode: requests to drive (0 = platform "
+                         "default)")
+    ap.add_argument("--checkpoint_dir", default=None,
+                    help="serve mode: checkpoint to serve (fresh init when "
+                         "unset)")
     ap.add_argument("--input", choices=("cached", "loader", "both"),
                     default="cached")
     ap.add_argument("--records", type=int, default=1024,
@@ -125,6 +173,8 @@ def main(argv=None):
                          "pulls state.step (the honest fence, ADVICE r3). "
                          "Exists to attribute cross-round deltas.")
     flags = ap.parse_args(argv)
+    if flags.mode == "serve":
+        return _serve_bench(flags)
     import jax
 
     from distributed_tensorflow_tpu import cluster as cluster_lib

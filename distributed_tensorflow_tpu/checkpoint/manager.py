@@ -94,11 +94,52 @@ class CheckpointManager:
         logger.info("resumed from checkpoint step %s", self.latest_step())
         return restored
 
+    def restore_params(self, step: Optional[int] = None):
+        """Inference-only restore: ``(params, model_state)`` as host arrays.
+
+        Reads the raw saved tree (no template), so the caller never has to
+        reconstruct the optimizer that wrote the checkpoint and no
+        optimizer moments are sharded onto devices — the serve engine's
+        restore path.  ``model_state`` is ``{}`` for stateless models.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        tree = self._mngr.restore(step, args=ocp.args.StandardRestore())
+        # A TrainState round-trips through StandardSave as a dict of its
+        # pytree fields; tolerate an attr-style container too.
+        if isinstance(tree, dict):
+            return tree["params"], dict(tree.get("model_state") or {})
+        return tree.params, dict(getattr(tree, "model_state", None) or {})
+
+    # -- teardown surface ----------------------------------------------------
+    # Async orbax saves run on background threads that can outlive short
+    # serve/bench processes; ``close`` is the one call every owner (train
+    # teardown, serve engine, evaluator) makes — it drains outstanding
+    # saves first and is safe to call twice.
+
     def wait_until_finished(self) -> None:
-        self._mngr.wait_until_finished()
+        if self._mngr is not None:
+            self._mngr.wait_until_finished()
 
     def close(self) -> None:
+        if self._mngr is None:
+            return
+        self.wait_until_finished()
         self._mngr.close()
+        self._mngr = None
+
+    @property
+    def closed(self) -> bool:
+        return self._mngr is None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
 
 
 def _abstractify(x):

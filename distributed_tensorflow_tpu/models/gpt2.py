@@ -109,6 +109,7 @@ class Block(nn.Module):
     cfg: GPT2Config
     mesh: Optional[Mesh] = None
     deterministic: bool = True  # attribute (not call arg) so nn.scan can map
+    decode: bool = False  # KV-cache incremental decode (serve path)
 
     @nn.compact
     def __call__(self, x, _=None):
@@ -124,7 +125,12 @@ class Block(nn.Module):
         q = q.reshape(B, T, h, head_dim)
         k = k.reshape(B, T, h, head_dim)
         v = v.reshape(B, T, h, head_dim)
-        if self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
+        if self.decode:
+            # Serve path: exact attention over the preallocated KV cache.
+            # Takes precedence over ring/flash — both are training-shape
+            # kernels; decode works on (B, 1, ...) steps against the cache.
+            ctx = self._cached_attention(q, k, v).reshape(B, T, d)
+        elif self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
             # Long-context path: sequence sharded over the context axis, KV
             # rotating over the ICI ring (parallel.ring_attention).  Exact
             # attention incl. attention-prob dropout (per-block dropout
@@ -164,6 +170,43 @@ class Block(nn.Module):
         mlp = nn.Dropout(cfg.dropout, deterministic=deterministic)(mlp)
         return x + mlp, None
 
+    def _cached_attention(self, q, k, v):
+        """Exact attention over a preallocated (B, S, H, hd) KV cache.
+
+        The cache geometry (S = max decode length) is fixed by the shape of
+        the ``decode=True`` init call; afterwards any call length T works as
+        long as ``cache_index + T <= S`` — one call with the whole prompt
+        (prefill), then T=1 steps.  Keys at positions ``> cache_index +
+        query_offset`` are masked, so right-padding the cache never leaks
+        into the softmax.  Heads shard over the ``tensor`` axis exactly like
+        the training path (the cache rides the same column-parallel qkv
+        layout — see ``gpt2_cache_rules``).
+        """
+        cfg = self.cfg
+        B, T, h, head_dim = q.shape
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((B, T, h, head_dim), cfg.dtype))
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((B, T, h, head_dim), cfg.dtype))
+        ci = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        k_all = lax.dynamic_update_slice(
+            ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0))
+        v_all = lax.dynamic_update_slice(
+            cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0))
+        ck.value, cv.value, ci.value = k_all, v_all, idx + T
+        S = k_all.shape[1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) / np.sqrt(head_dim)
+        q_pos = idx + jnp.arange(T)
+        mask = jnp.arange(S)[None, :] <= q_pos[:, None]  # (T, S) causal
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
 
 class GPT2(nn.Module):
     cfg: GPT2Config
@@ -171,7 +214,7 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, decode: bool = False):
         cfg = self.cfg
         B, T = tokens.shape
         wte = self.param(
@@ -186,9 +229,26 @@ class GPT2(nn.Module):
             (cfg.n_positions, cfg.d_model),
             jnp.float32,
         )
-        x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
+        if decode:
+            # KV-cache decode (serve path): positions continue from where
+            # the cache left off.  The init call (full max-length input)
+            # fixes the cache geometry; apply calls advance ``position``.
+            pos = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32))
+            offset = pos.value
+            x = wte[tokens].astype(cfg.dtype) + lax.dynamic_slice(
+                wpe, (offset, 0), (T, cfg.d_model)).astype(cfg.dtype)
+            pos.value = offset + T
+        else:
+            x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         pipe = self.mesh.shape.get("pipe", 1) if self.mesh is not None else 1
+        if decode and pipe > 1:
+            raise ValueError(
+                "decode=True with pipe>1 is unsupported: the serve engine "
+                "runs the scanned block stack directly (TP/DP shardings "
+                "apply; re-mesh without a pipe axis to serve)"
+            )
         if cfg.scan_layers and pipe > 1 and not self.is_initializing():
             # GPipe path: same "blocks" parameter layout as the scanned
             # stack (checkpoints and sharding rules are layout-stable in
@@ -202,23 +262,26 @@ class GPT2(nn.Module):
                 )
             x = self._pipelined_blocks(x)
         elif cfg.scan_layers:
-            body = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            # No remat in decode: there is no backward pass, and remat's
+            # lifted scope rejects the mutable cache writes.
+            use_remat = cfg.remat and not decode
+            body = nn.remat(Block, prevent_cse=False) if use_remat else Block
             Scanned = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 unroll=cfg.scan_unroll,
             )
             x, _ = Scanned(
                 cfg, mesh=self.mesh, deterministic=deterministic,
-                name="blocks",
+                decode=decode, name="blocks",
             )(x)
         else:
             for i in range(cfg.n_layer):
                 x, _ = Block(
                     cfg, mesh=self.mesh, deterministic=deterministic,
-                    name=f"h_{i}",
+                    decode=decode, name=f"h_{i}",
                 )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
@@ -484,6 +547,25 @@ def gpt2_rules() -> ShardingRules:
             (r"wpe$", P()),
             (r"mlp_c_fc/kernel", P("fsdp", "tensor")),
             (r"mlp_c_proj/kernel", P("tensor", "fsdp")),
+        ]
+    )
+
+
+def gpt2_cache_rules() -> ShardingRules:
+    """Sharding for the decode KV cache ("cache" collection).
+
+    Cached k/v are (B, S, H, head_dim) — (L, B, S, H, head_dim) under the
+    scanned "blocks" layout — with the batch over the data axes and heads
+    over ``tensor``, matching the column-parallel qkv projection the cache
+    is written from (``transformer_rules``), so decode runs TP without any
+    resharding at the cache boundary.  Scalar indices stay replicated.
+    """
+    return ShardingRules(
+        [
+            (r"blocks/cached_(key|value)",
+             P(None, ("data", "fsdp"), None, "tensor")),
+            (r"cached_(key|value)", P(("data", "fsdp"), None, "tensor")),
+            (r"(cache_index|position)", P()),
         ]
     )
 

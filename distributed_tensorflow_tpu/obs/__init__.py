@@ -19,11 +19,13 @@ from distributed_tensorflow_tpu.obs.profiling import (
     Profile,
     start_profiler_server,
 )
+from distributed_tensorflow_tpu.obs.serve import ServeMonitorHook
 
 __all__ = [
     "MetricsFileWriter",
     "PrefetchMonitorHook",
     "Profile",
+    "ServeMonitorHook",
     "TensorBoardHook",
     "start_profiler_server",
 ]

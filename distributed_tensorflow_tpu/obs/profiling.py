@@ -18,14 +18,26 @@ import jax
 logger = logging.getLogger(__name__)
 
 _SERVER = None
+_PORT: Optional[int] = None
 
 
 def start_profiler_server(port: int = 9012):
-    """Start the profiler gRPC endpoint once; returns the server handle."""
-    global _SERVER
+    """Start the profiler gRPC endpoint once; returns the server handle.
+
+    The process can host ONE profiler server.  A second call is a no-op
+    returning the existing handle; if it asks for a DIFFERENT port, that
+    request cannot be honored — warn with the port that is actually live
+    instead of silently handing back a server listening elsewhere.
+    """
+    global _SERVER, _PORT
     if _SERVER is None:
         _SERVER = jax.profiler.start_server(port)
+        _PORT = port
         logger.info("profiler server listening on :%d", port)
+    elif port != _PORT:
+        logger.warning(
+            "profiler server already listening on :%d; ignoring request "
+            "for :%d (one server per process)", _PORT, port)
     return _SERVER
 
 

@@ -1,0 +1,73 @@
+"""Serving observability: the batcher's counters on the metric surface.
+
+Mirrors ``PrefetchMonitorHook``: whatever exposes ``stats()`` (the
+``serve.DynamicBatcher``) gets snapshotted — queue depth vs capacity, batch
+occupancy, p50/p99 request latency, rejects — both into a log line and into
+a metrics dict, so saturation (depth at capacity, rejects climbing) and
+under-batching (occupancy ~1 with latency at the timeout floor) are visible
+the same way input-pipeline stalls are.
+
+The serve loop has no ``TrainLoop``, so the hook works standalone
+(``log(step)`` / ``metrics()``) AND as a loop hook (``after_step``/``end``)
+for anyone embedding evaluation-style serving inside a training run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from distributed_tensorflow_tpu.training.loop import Hook
+
+logger = logging.getLogger(__name__)
+
+
+class ServeMonitorHook(Hook):
+    """Snapshots ``source.stats()`` (prefixed ``serve_``) every
+    ``every_steps`` requests/steps."""
+
+    def __init__(self, source, *, every_steps: int = 100):
+        self._source = source
+        self.every_steps = max(1, every_steps)
+        self.last_stats: Dict[str, float] = {}
+
+    def _snapshot(self) -> Optional[Dict[str, float]]:
+        stats = getattr(self._source, "stats", None)
+        if not callable(stats):
+            return None
+        self.last_stats = stats()
+        return self.last_stats
+
+    def metrics(self) -> Dict[str, float]:
+        """Current counters under the ``serve_`` metric namespace."""
+        s = self._snapshot() or {}
+        return {f"serve_{k}": v for k, v in s.items()}
+
+    def log(self, step: int) -> Optional[Dict[str, float]]:
+        """Standalone export: log the snapshot, return the metrics dict."""
+        s = self._snapshot()
+        if s is None:
+            return None
+        logger.info(
+            "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "
+            "occupancy=%.2f p50=%.1fms p99=%.1fms",
+            step, int(s.get("queue_depth", 0)), int(s.get("capacity", 0)),
+            int(s.get("completed", 0)), int(s.get("rejected", 0)),
+            int(s.get("batches", 0)), s.get("avg_batch_occupancy", 0.0),
+            s.get("p50_latency_ms", 0.0), s.get("p99_latency_ms", 0.0),
+        )
+        return {f"serve_{k}": v for k, v in s.items()}
+
+    # -- TrainLoop-embedded usage (same shape as PrefetchMonitorHook) --------
+
+    def after_step(self, loop, step, metrics):
+        if step % self.every_steps or step <= 0:
+            return
+        m = self.log(step)
+        if m:
+            loop.last_logged_metrics.update(m)
+
+    def end(self, loop, step):
+        m = self.metrics()
+        if m:
+            loop.last_logged_metrics.update(m)

@@ -1,0 +1,29 @@
+"""TPU-native inference subsystem (the north star's "serve heavy traffic"
+leg): checkpoint -> sharded inference params -> KV-cache decode / batched
+classify, fronted by a dynamic micro-batcher with admission control.
+
+Layers:
+
+- ``engine``: restore + re-shard + jitted forward (``ServeEngine``);
+- ``batcher``: request coalescing, bucketed shapes, backpressure
+  (``DynamicBatcher`` / ``ServeOverloadedError``);
+- ``driver``: the in-process request loop behind ``serve.py`` and
+  ``bench.py --mode=serve`` (``run_serve`` / ``ServeArgs``);
+- ``obs.ServeMonitorHook`` exports the batcher's counters.
+"""
+
+from distributed_tensorflow_tpu.serve.batcher import (
+    DynamicBatcher,
+    ServeOverloadedError,
+)
+from distributed_tensorflow_tpu.serve.driver import ServeArgs, run_serve
+from distributed_tensorflow_tpu.serve.engine import ServeEngine, pad_rows
+
+__all__ = [
+    "DynamicBatcher",
+    "ServeArgs",
+    "ServeEngine",
+    "ServeOverloadedError",
+    "pad_rows",
+    "run_serve",
+]

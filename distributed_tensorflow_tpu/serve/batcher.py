@@ -1,0 +1,252 @@
+"""Dynamic micro-batching for the serve engine.
+
+Behavioral model: TF Serving's ``BatchingSession`` / ``SharedBatchScheduler``
+(batch coalescing with a timeout, bounded queues with rejection) and the
+Orca-style request scheduler (PAPERS.md) — minus continuous batching, which
+is an open item (ROADMAP).
+
+Mechanics: requests enqueue on a bounded, bucketed pending table and get a
+``concurrent.futures.Future`` back.  One scheduler thread coalesces up to
+``max_batch_size`` requests per bucket and flushes a bucket when it is full
+or when its OLDEST request has waited ``batch_timeout_ms`` — the classic
+latency/occupancy trade.  Buckets (``bucket_fn``, e.g. prompt length) keep
+each flushed batch shape-uniform so the engine compiles a bounded set of
+programs; a full bucket flushes ahead of an older partial one, so futures
+complete out of submission order by design.  Admission control is a hard
+bound: past ``max_queue_size`` pending requests, ``submit`` raises
+``ServeOverloadedError`` immediately (backpressure to the caller) instead of
+growing the queue without bound.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class ServeOverloadedError(RuntimeError):
+    """Admission control rejected the request: the pending queue is full.
+
+    The caller should back off and retry (or shed load) — queueing further
+    would only grow tail latency past any useful deadline.
+    """
+
+
+@dataclasses.dataclass
+class _Request:
+    payload: Any
+    future: Future
+    enqueued: float  # time.monotonic() at submit
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class DynamicBatcher:
+    """Coalesces concurrent requests into engine-sized batches.
+
+    ``run_batch(payloads: list) -> list`` is called on the scheduler thread
+    with 1..max_batch_size payloads from ONE bucket and must return one
+    result per payload, in order.  Each result resolves its request's
+    future; an exception fails every future in the batch (callers see the
+    engine error, not a hang).
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[List[Any]], List[Any]],
+        *,
+        max_batch_size: int = 8,
+        batch_timeout_ms: float = 5.0,
+        max_queue_size: int = 64,
+        bucket_fn: Optional[Callable[[Any], Hashable]] = None,
+        name: str = "serve",
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+        self._run_batch = run_batch
+        self.max_batch_size = max_batch_size
+        self.batch_timeout_s = batch_timeout_ms / 1000.0
+        self.max_queue_size = max_queue_size
+        self._bucket_fn = bucket_fn
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # bucket key -> FIFO of _Request (insertion-ordered so the oldest
+        # bucket's deadline is found without scanning timestamps twice).
+        self._pending: "collections.OrderedDict[Hashable, collections.deque]" = (
+            collections.OrderedDict()
+        )
+        self._depth = 0
+        self._stopped = False
+        # counters (under _lock)
+        self._submitted = 0
+        self._completed = 0
+        self._rejected = 0
+        self._failed = 0
+        self._batches = 0
+        self._occupancy_sum = 0
+        self._last_occupancy = 0
+        self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
+        self._thread = threading.Thread(
+            target=self._scheduler_loop, daemon=True, name=f"{name}-batcher"
+        )
+        self._thread.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, payload: Any) -> Future:
+        """Enqueue one request; returns a Future resolving to its result.
+
+        Raises ``ServeOverloadedError`` when the pending queue is at
+        ``max_queue_size`` (admission control) and ``RuntimeError`` after
+        ``close()``.
+        """
+        fut: Future = Future()
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("DynamicBatcher is closed")
+            if self._depth >= self.max_queue_size:
+                self._rejected += 1
+                raise ServeOverloadedError(
+                    f"serve queue full ({self._depth}/{self.max_queue_size} "
+                    "pending); back off and retry"
+                )
+            key = self._bucket_fn(payload) if self._bucket_fn else None
+            self._pending.setdefault(key, collections.deque()).append(
+                _Request(payload, fut, time.monotonic())
+            )
+            self._depth += 1
+            self._submitted += 1
+            self._cond.notify()
+        return fut
+
+    def stats(self) -> Dict[str, float]:
+        """Counter snapshot (the ServeMonitorHook export surface)."""
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            batches = self._batches
+            return {
+                "queue_depth": float(self._depth),
+                "capacity": float(self.max_queue_size),
+                "submitted": float(self._submitted),
+                "completed": float(self._completed),
+                "rejected": float(self._rejected),
+                "failed": float(self._failed),
+                "batches": float(batches),
+                "avg_batch_occupancy": (
+                    self._occupancy_sum / batches if batches else 0.0
+                ),
+                "last_batch_occupancy": float(self._last_occupancy),
+                "p50_latency_ms": _percentile(lat, 0.50),
+                "p99_latency_ms": _percentile(lat, 0.99),
+            }
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the scheduler; fail any still-pending futures.
+
+        Idempotent.  The in-flight batch (if any) finishes first — its
+        futures resolve normally.
+        """
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            leftover = [r for q in self._pending.values() for r in q]
+            self._pending.clear()
+            self._depth = 0
+        for r in leftover:
+            r.future.set_exception(RuntimeError("DynamicBatcher closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _pop_locked(self, key: Hashable) -> List[_Request]:
+        q = self._pending[key]
+        n = min(len(q), self.max_batch_size)
+        reqs = [q.popleft() for _ in range(n)]
+        if not q:
+            del self._pending[key]
+        self._depth -= n
+        return reqs
+
+    def _next_batch_locked(self, now: float):
+        """(batch, deadline): a flushable batch, else the earliest deadline.
+
+        Flush policy: any FULL bucket first (throughput); else any bucket
+        whose oldest request has aged past the timeout (latency bound).
+        """
+        deadline = None
+        for key, q in self._pending.items():
+            if len(q) >= self.max_batch_size:
+                return self._pop_locked(key), None
+            d = q[0].enqueued + self.batch_timeout_s
+            if d <= now:
+                return self._pop_locked(key), None
+            deadline = d if deadline is None else min(deadline, d)
+        return None, deadline
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    batch, deadline = self._next_batch_locked(time.monotonic())
+                    if batch is not None:
+                        break
+                    if self._stopped:
+                        return
+                    wait = (None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))
+                    self._cond.wait(wait)
+            self._dispatch(batch)
+
+    def _dispatch(self, reqs: List[_Request]) -> None:
+        error: Optional[BaseException] = None
+        results: List[Any] = []
+        try:
+            results = self._run_batch([r.payload for r in reqs])
+            if len(results) != len(reqs):
+                raise RuntimeError(
+                    f"run_batch returned {len(results)} results for "
+                    f"{len(reqs)} requests"
+                )
+        except BaseException as e:  # noqa: BLE001 — forwarded to futures
+            error = e
+        done = time.monotonic()
+        with self._lock:
+            self._batches += 1
+            self._occupancy_sum += len(reqs)
+            self._last_occupancy = len(reqs)
+            if error is None:
+                self._completed += len(reqs)
+            else:
+                self._failed += len(reqs)
+            for r in reqs:
+                self._latencies_ms.append((done - r.enqueued) * 1000.0)
+        if error is not None:
+            logger.exception("serve batch of %d failed", len(reqs),
+                             exc_info=error)
+            for r in reqs:
+                r.future.set_exception(error)
+        else:
+            for r, res in zip(reqs, results):
+                r.future.set_result(res)

@@ -1,0 +1,265 @@
+"""Inference engine: checkpoint -> sharded params -> jitted forward.
+
+The serving counterpart of ``train_lib.build_state_and_step``: restore a
+checkpoint into INFERENCE-ONLY variables (no optimizer state ever
+materializes on device — ``CheckpointManager.restore_params`` reads the raw
+tree and keeps only params/model_state), re-shard them to the current mesh
+with the workload's ``ShardingRules``, and serve two jitted paths:
+
+- ``generate``: GPT-2 prefill + KV-cache incremental decode
+  (``models.gpt2`` ``decode=True``); the cache is preallocated per
+  (batch, total_len) geometry and TP-sharded over heads
+  (``gpt2_cache_rules``), batch over the data axes.
+- ``classify``: single batched forward for the classification workloads
+  (mnist / resnet50 / bert), deterministic, BatchNorm on running stats.
+
+Shape discipline: callers go through ``pad_rows``/``bucket_rows`` so each
+jitted program sees a small fixed set of batch shapes (the dynamic batcher
+bounds the set further by bucketing requests); the batch dim is always a
+multiple of the mesh's data-parallel extent so GSPMD never sees an uneven
+batch split.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu import cluster as cluster_lib
+from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.models import Workload, get_workload
+from distributed_tensorflow_tpu.parallel.sharding import (
+    apply_shardings,
+    batch_sharding,
+)
+
+logger = logging.getLogger(__name__)
+PyTree = Any
+
+
+def pad_rows(arr: np.ndarray, target: int) -> np.ndarray:
+    """Pad the leading (batch) dim to ``target`` rows by repeating the last
+    row — inert filler whose outputs the caller slices off."""
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError(f"batch {n} exceeds padded target {target}")
+    pad = np.repeat(arr[-1:], target - n, axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class ServeEngine:
+    """Checkpoint-backed inference over a mesh.
+
+    ``checkpoint_dir=None`` (or an empty directory) falls back to fresh
+    random init — the smoke/bench path when no training run preceded.
+    """
+
+    def __init__(
+        self,
+        model: str = "gpt2",
+        *,
+        mesh=None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_step: Optional[int] = None,
+        seed: int = 0,
+        **workload_overrides,
+    ):
+        self.mesh = mesh if mesh is not None else cluster_lib.build_mesh(
+            cluster_lib.MeshConfig())
+        self.workload: Workload = get_workload(
+            model, mesh=self.mesh, **workload_overrides)
+        self.model = model
+        self.module = self.workload.module
+        self._manager: Optional[CheckpointManager] = None
+        self._generate_fns: Dict[Any, Callable] = {}
+        self._cache_init_fns: Dict[Any, Callable] = {}
+        self.restored_step: Optional[int] = None
+
+        def init_fn():
+            init_input = (
+                self.workload.init_batch if self.workload.init_key is None
+                else self.workload.init_batch[self.workload.init_key]
+            )
+            return dict(self.module.init(jax.random.key(seed), init_input))
+
+        abstract = jax.eval_shape(init_fn)
+        shardings = self.workload.rules.shardings_for(self.mesh, abstract)
+        restored = None
+        if checkpoint_dir:
+            self._manager = CheckpointManager(checkpoint_dir)
+            if self._manager.latest_step() is not None:
+                params, model_state = self._manager.restore_params(
+                    checkpoint_step)
+                restored = dict(model_state or {})
+                restored["params"] = params
+                self.restored_step = (
+                    checkpoint_step if checkpoint_step is not None
+                    else self._manager.latest_step())
+                logger.info("serving checkpoint step %s from %s",
+                            self.restored_step, checkpoint_dir)
+            else:
+                logger.warning(
+                    "no checkpoint under %s — serving FRESH-INIT params",
+                    checkpoint_dir)
+        if restored is not None:
+            variables = apply_shardings(restored, shardings)
+        else:
+            variables = jax.jit(init_fn, out_shardings=shardings)()
+        self.params = variables.pop("params")
+        self.model_state = variables  # e.g. {"batch_stats": ...} for resnet
+        self._predict_fn = jax.jit(self._predict_apply)
+
+    # -- generate (gpt2 KV-cache decode) -------------------------------------
+
+    @property
+    def data_parallelism(self) -> int:
+        return (self.mesh.shape.get("data", 1)
+                * self.mesh.shape.get("fsdp", 1))
+
+    def bucket_rows(self, n: int) -> int:
+        """Smallest power-of-two multiple of the data-parallel extent that
+        fits ``n`` rows — the padded batch shapes jitted programs see."""
+        b = max(1, self.data_parallelism)
+        while b < n:
+            b *= 2
+        return b
+
+    def _decode_apply(self, params, cache, tokens):
+        logits, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens,
+            decode=True, mutable=["cache"],
+        )
+        next_tokens = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return next_tokens, mutated["cache"]
+
+    def init_cache(self, batch: int, total_len: int) -> PyTree:
+        """Preallocated, sharded KV cache for ``batch`` rows of up to
+        ``total_len`` (prompt + generated) tokens."""
+        from distributed_tensorflow_tpu.models.gpt2 import gpt2_cache_rules
+
+        key = (batch, total_len)
+        if key not in self._cache_init_fns:
+            def mk():
+                vs = self.module.init(
+                    jax.random.key(0),
+                    jnp.zeros((batch, total_len), jnp.int32), decode=True)
+                return vs["cache"]
+
+            shapes = jax.eval_shape(mk)
+            shardings = gpt2_cache_rules().shardings_for(self.mesh, shapes)
+            self._cache_init_fns[key] = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+                out_shardings=shardings,
+            )
+        return self._cache_init_fns[key]()
+
+    def generate(self, prompts: np.ndarray, max_new_tokens: int) -> np.ndarray:
+        """Greedy decode: (B, T_prompt) int32 -> (B, max_new_tokens) int32.
+
+        One prefill call over the whole prompt fills the cache and yields
+        the first new token; each further token is a (B, 1) decode step
+        against the cache — never a full-sequence forward.  The (B,
+        T_prompt) prefill and (B, 1) decode programs compile once per
+        shape; the cache is donated through the step so decode updates it
+        in place.
+        """
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim != 2:
+            raise ValueError(f"prompts must be (B, T), got {prompts.shape}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        B, T = prompts.shape
+        cfg = getattr(self.module, "cfg", None)
+        total = T + max_new_tokens
+        if cfg is not None and total > cfg.n_positions:
+            raise ValueError(
+                f"prompt {T} + max_new_tokens {max_new_tokens} exceeds "
+                f"n_positions {cfg.n_positions}")
+        if "step" not in self._generate_fns:
+            self._generate_fns["step"] = jax.jit(
+                self._decode_apply, donate_argnums=(1,))
+        step = self._generate_fns["step"]
+        cache = self.init_cache(B, total)
+        tokens_dev = jax.device_put(prompts, batch_sharding(self.mesh))
+        tok, cache = step(self.params, cache, tokens_dev)
+        out = [tok]
+        for _ in range(max_new_tokens - 1):
+            tok, cache = step(self.params, cache, tok[:, None])
+            out.append(tok)
+        return np.asarray(jax.device_get(jnp.stack(out, axis=1)))
+
+    def generate_batch(self, prompts: List[np.ndarray],
+                       max_new_tokens: int) -> List[np.ndarray]:
+        """Batcher adapter: list of same-length 1-D prompts -> list of
+        generated 1-D token arrays.  Groups by prompt length defensively
+        (the batcher's bucket_fn normally guarantees uniformity) and pads
+        the batch dim to the engine's bucketed shapes."""
+        by_len: Dict[int, List[int]] = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        results: List[Optional[np.ndarray]] = [None] * len(prompts)
+        for _, idxs in by_len.items():
+            stacked = np.stack([prompts[i] for i in idxs]).astype(np.int32)
+            padded = pad_rows(stacked, self.bucket_rows(len(idxs)))
+            gen = self.generate(padded, max_new_tokens)
+            for row, i in enumerate(idxs):
+                results[i] = gen[row]
+        return results  # type: ignore[return-value]
+
+    # -- classify (mnist / resnet50 / bert) ----------------------------------
+
+    def _predict_apply(self, params, model_state, batch):
+        variables = {"params": params, **model_state}
+        if self.model == "resnet50":
+            return self.module.apply(variables, batch["image"], train=False)
+        if self.model == "mnist":
+            return self.module.apply(variables, batch["image"])
+        if self.model == "bert":
+            # Sentence-level head: the NSP logits are the classify surface.
+            _mlm, nsp = self.module.apply(
+                variables, batch, deterministic=True)
+            return nsp
+        raise NotImplementedError(
+            f"no serve predict path for model {self.model!r}")
+
+    def classify(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Batched deterministic forward -> host logits array."""
+        sh = batch_sharding(self.mesh)
+        dev_batch = {k: jax.device_put(np.asarray(v), sh)
+                     for k, v in batch.items()}
+        return np.asarray(jax.device_get(
+            self._predict_fn(self.params, self.model_state, dev_batch)))
+
+    def classify_batch(self, examples: List[Dict[str, np.ndarray]]
+                       ) -> List[int]:
+        """Batcher adapter: list of single examples -> list of class ids."""
+        keys = examples[0].keys()
+        stacked = {k: np.stack([np.asarray(e[k]) for e in examples])
+                   for k in keys}
+        target = self.bucket_rows(len(examples))
+        padded = {k: pad_rows(v, target) for k, v in stacked.items()}
+        logits = self.classify(padded)
+        return [int(np.argmax(logits[i], axis=-1))
+                for i in range(len(examples))]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Release the checkpoint manager (waits out async orbax I/O)."""
+        if self._manager is not None:
+            self._manager.close()
+            self._manager = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Serving entrypoint — restore a checkpoint, serve batched inference.
+
+Counterpart of ``train.py`` for the inference side: an in-process request
+loop (synthetic clients -> DynamicBatcher -> ServeEngine) that prints ONE
+JSON line of serve metrics (tokens/sec, latency percentiles, occupancy).
+
+Examples:
+    python serve.py --model=gpt2 --steps=32                  # fresh-init smoke
+    python serve.py --model=gpt2 --checkpoint_dir=/tmp/ckpt --max_batch_size=8
+    python serve.py --model=mnist --steps=64                 # classify path
+    python serve.py --model=gpt2 --tensor=2                  # TP decode
+"""
+
+import argparse
+import json
+import logging
+import os
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+
+def parse_args(argv=None):
+    from distributed_tensorflow_tpu.serve import ServeArgs
+
+    defaults = ServeArgs()
+    p = argparse.ArgumentParser(description="TPU-native batched serving")
+    p.add_argument("--model", default=defaults.model,
+                   help="gpt2 (KV-cache decode) or mnist|resnet50|bert "
+                        "(batched classify)")
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="restore params from here (fresh random init when "
+                        "unset or empty — the smoke path)")
+    p.add_argument("--steps", type=int, default=defaults.steps,
+                   help="number of requests to drive")
+    p.add_argument("--max_batch_size", type=int,
+                   default=defaults.max_batch_size)
+    p.add_argument("--batch_timeout_ms", type=float,
+                   default=defaults.batch_timeout_ms,
+                   help="flush a partial batch after its oldest request "
+                        "waited this long")
+    p.add_argument("--max_queue_size", type=int,
+                   default=defaults.max_queue_size,
+                   help="admission control: pending requests past this "
+                        "bound are rejected with backpressure")
+    p.add_argument("--max_new_tokens", type=int,
+                   default=defaults.max_new_tokens)
+    p.add_argument("--prompt_len", type=int, default=defaults.prompt_len)
+    p.add_argument("--clients", type=int, default=defaults.clients,
+                   help="concurrent synthetic client threads")
+    p.add_argument("--preset", default=None,
+                   help="gpt2 config preset (tiny|small|medium); default "
+                        "tiny on CPU, medium on TPU")
+    for axis in ("data", "fsdp", "tensor"):
+        p.add_argument(f"--{axis}", type=int,
+                       default=getattr(defaults, axis),
+                       help=f"mesh size of the {axis!r} axis")
+    p.add_argument("--log_every", type=int, default=defaults.log_every)
+    p.add_argument("--seed", type=int, default=defaults.seed)
+    return ServeArgs(**vars(p.parse_args(argv)))
+
+
+def main(argv=None):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+        force=True,
+    )
+    from distributed_tensorflow_tpu.serve import run_serve
+
+    result = run_serve(parse_args(argv))
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,35 @@ class TestEvalReachesWriters:
         assert os.listdir(tb)
 
 
+class TestStartProfilerServer:
+    def test_second_call_with_different_port_warns(self, monkeypatch, caplog):
+        import logging
+
+        from distributed_tensorflow_tpu.obs import profiling as prof
+
+        started = []
+        monkeypatch.setattr(prof, "_SERVER", None)
+        monkeypatch.setattr(prof, "_PORT", None)
+        monkeypatch.setattr(
+            prof.jax.profiler, "start_server",
+            lambda port: started.append(port) or object())
+
+        with caplog.at_level(logging.INFO, logger=prof.__name__):
+            h1 = prof.start_profiler_server(9012)
+            h2 = prof.start_profiler_server(9012)  # same port: silent no-op
+            warnings = [r for r in caplog.records
+                        if r.levelno == logging.WARNING]
+            assert h2 is h1 and not warnings
+            h3 = prof.start_profiler_server(9999)  # conflicting port
+        assert h3 is h1
+        assert started == [9012], "server must only ever start once"
+        warnings = [r for r in caplog.records if r.levelno == logging.WARNING]
+        assert len(warnings) == 1
+        # The warning names BOTH the live port and the ignored request.
+        assert "9012" in warnings[0].getMessage()
+        assert "9999" in warnings[0].getMessage()
+
+
 class TestProfile:
     def test_trace_context_manager(self, tmp_path):
         import jax

@@ -1,0 +1,59 @@
+"""Smoke tests for the serve entrypoints' driver contract: ONE parseable
+JSON line from ``serve.py`` and from ``bench.py --mode=serve``.
+
+Marked ``slow`` (excluded from tier-1, like test_bench_smoke.py) — each
+subprocess compiles the tiny GPT-2 prefill + decode programs cold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable] + cmd,
+        capture_output=True, text=True, timeout=1200, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    return json.loads(lines[-1])  # the contract: last line is the JSON
+
+
+@pytest.mark.slow
+def test_serve_entrypoint_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--steps=16", "--prompt_len=8", "--max_new_tokens=4",
+                "--max_batch_size=8"])
+    for key in ("model", "requests", "completed", "tokens_per_sec",
+                "p50_latency_ms", "p99_latency_ms", "avg_batch_occupancy",
+                "batches", "checkpoint_step"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["completed"] == 16
+    assert out["tokens_per_sec"] > 0
+    assert out["p99_latency_ms"] >= out["p50_latency_ms"]
+    assert out["checkpoint_step"] is None  # fresh-init smoke path
+
+
+@pytest.mark.slow
+def test_bench_serve_mode_prints_one_json_line():
+    out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
+                "--serve_requests=16"])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "p50_latency_ms", "p99_latency_ms"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["unit"] == "tokens/sec"
+    assert out["value"] > 0
+    assert "serve_tokens_per_sec" in out["metric"]

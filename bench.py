@@ -107,39 +107,80 @@ def _spread(rates):
 
 
 def _serve_bench(flags):
-    """``--mode=serve``: tokens/sec + latency percentiles through the full
-    serve stack (checkpoint/fresh-init -> KV-cache decode -> dynamic
-    batcher), one JSON line like the train bench."""
+    """``--mode=serve``: both scheduling disciplines over ONE engine —
+    fixed request-level batching, then continuous (iteration-level)
+    batching — on the SAME mixed-length/mixed-horizon traffic, one JSON
+    line like the train bench.
+
+    Headline ``value`` is the continuous scheduler's delivered tokens/sec
+    (``fixed_*`` keys carry the baseline and ``continuous_speedup`` the
+    ratio); p50/p99 are the continuous run's so a regression in the new
+    path can't hide behind the baseline."""
+    import dataclasses
+
     import jax
 
-    from distributed_tensorflow_tpu.serve import ServeArgs, run_serve
+    from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.serve import (ServeArgs, ServeEngine,
+                                                  run_serve)
 
     on_tpu = jax.devices()[0].platform == "tpu"
     # TPU serves the paper's GPT-2-medium; CPU smoke serves the test config
-    # with a short horizon so the line still prints quickly.
+    # with a short horizon so the line still prints quickly.  Mixed prompt
+    # lengths + horizons: the workload where the two disciplines actually
+    # differ (uniform traffic makes them near-equivalent).
     if on_tpu:
-        sargs = ServeArgs(model="gpt2", steps=max(64, flags.serve_requests),
-                          prompt_len=64, max_new_tokens=64,
+        fixed = ServeArgs(model="gpt2", steps=max(64, flags.serve_requests),
+                          prompt_len=64, prompt_lens="32,64,96",
+                          max_new_tokens=64, min_new_tokens=8,
+                          num_slots=16,
                           checkpoint_dir=flags.checkpoint_dir)
+        preset = "medium"
     else:
-        sargs = ServeArgs(model="gpt2", preset="tiny",
+        fixed = ServeArgs(model="gpt2", preset="tiny",
                           steps=flags.serve_requests or 16,
-                          prompt_len=8, max_new_tokens=8,
+                          prompt_len=8, prompt_lens="6,8,12",
+                          max_new_tokens=8, min_new_tokens=2,
+                          num_slots=8,
                           checkpoint_dir=flags.checkpoint_dir)
-    result = run_serve(sargs)
+        preset = "tiny"
+    continuous = dataclasses.replace(fixed, continuous=True)
+
+    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(
+        data=fixed.data, fsdp=fixed.fsdp, tensor=fixed.tensor))
+    engine = ServeEngine("gpt2", mesh=mesh,
+                         checkpoint_dir=flags.checkpoint_dir,
+                         seed=fixed.seed, preset=preset)
+    try:
+        fixed_res = run_serve(fixed, engine=engine)
+        cont_res = run_serve(continuous, engine=engine)
+    finally:
+        engine.close()
+
     metric = ("gpt2_serve_tokens_per_sec" if on_tpu
               else "gpt2_tiny_cpu_smoke_serve_tokens_per_sec")
     out = {
         "metric": metric,
-        "value": result["tokens_per_sec"],
+        "value": cont_res["tokens_per_sec"],
         "unit": "tokens/sec",
         "vs_baseline": 1.0,  # serving has no ladder anchor yet (first PR)
-        "p50_latency_ms": result["p50_latency_ms"],
-        "p99_latency_ms": result["p99_latency_ms"],
-        "avg_batch_occupancy": result["avg_batch_occupancy"],
-        "requests": result["requests"],
-        "completed": result["completed"],
-        "checkpoint_step": result["checkpoint_step"],
+        "p50_latency_ms": cont_res["p50_latency_ms"],
+        "p99_latency_ms": cont_res["p99_latency_ms"],
+        "ttft_p50_ms": cont_res["ttft_p50_ms"],
+        "ttft_p99_ms": cont_res["ttft_p99_ms"],
+        "tpot_mean_ms": cont_res["tpot_mean_ms"],
+        "slot_occupancy": cont_res["slot_occupancy"],
+        "num_slots": cont_res["num_slots"],
+        "fixed_tokens_per_sec": fixed_res["tokens_per_sec"],
+        "fixed_p50_latency_ms": fixed_res["p50_latency_ms"],
+        "fixed_p99_latency_ms": fixed_res["p99_latency_ms"],
+        "avg_batch_occupancy": fixed_res["avg_batch_occupancy"],
+        "continuous_speedup": round(
+            cont_res["tokens_per_sec"]
+            / max(fixed_res["tokens_per_sec"], 1e-9), 3),
+        "requests": cont_res["requests"],
+        "completed": cont_res["completed"],
+        "checkpoint_step": cont_res["checkpoint_step"],
     }
     print(json.dumps(out))
 

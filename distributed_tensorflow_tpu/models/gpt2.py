@@ -112,7 +112,7 @@ class Block(nn.Module):
     decode: bool = False  # KV-cache incremental decode (serve path)
 
     @nn.compact
-    def __call__(self, x, _=None):
+    def __call__(self, x, slot_ids=None):
         cfg = self.cfg
         deterministic = self.deterministic
         d, h = cfg.d_model, cfg.n_head
@@ -129,7 +129,7 @@ class Block(nn.Module):
             # Serve path: exact attention over the preallocated KV cache.
             # Takes precedence over ring/flash — both are training-shape
             # kernels; decode works on (B, 1, ...) steps against the cache.
-            ctx = self._cached_attention(q, k, v).reshape(B, T, d)
+            ctx = self._cached_attention(q, k, v, slot_ids).reshape(B, T, d)
         elif self.mesh is not None and self.mesh.shape.get("context", 1) > 1:
             # Long-context path: sequence sharded over the context axis, KV
             # rotating over the ICI ring (parallel.ring_attention).  Exact
@@ -170,7 +170,7 @@ class Block(nn.Module):
         mlp = nn.Dropout(cfg.dropout, deterministic=deterministic)(mlp)
         return x + mlp, None
 
-    def _cached_attention(self, q, k, v):
+    def _cached_attention(self, q, k, v, slot_ids=None):
         """Exact attention over a preallocated (B, S, H, hd) KV cache.
 
         The cache geometry (S = max decode length) is fixed by the shape of
@@ -181,9 +181,19 @@ class Block(nn.Module):
         into the softmax.  Heads shard over the ``tensor`` axis exactly like
         the training path (the cache rides the same column-parallel qkv
         layout — see ``gpt2_cache_rules``).
+
+        ``slot_ids=None`` is the fixed-batch path: ONE scalar
+        ``cache_index``, the whole batch advances in lockstep.  With
+        ``slot_ids`` (shape ``(B_call,)``, unique) the cache is a RESIDENT
+        slot table for continuous batching: ``cache_index`` is a
+        ``(num_slots,)`` vector, the call's rows are gathered from /
+        scattered back to their slots, and each row's K/V lands at its OWN
+        per-slot offset (``vmap``-ed ``dynamic_update_slice``), so requests
+        at different decode depths share one cache and one program.
         """
         cfg = self.cfg
         B, T, h, head_dim = q.shape
+        slot_mode = slot_ids is not None
         ck = self.variable(
             "cache", "cached_key",
             lambda: jnp.zeros((B, T, h, head_dim), cfg.dtype))
@@ -191,7 +201,30 @@ class Block(nn.Module):
             "cache", "cached_value",
             lambda: jnp.zeros((B, T, h, head_dim), cfg.dtype))
         ci = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            "cache", "cache_index",
+            lambda: jnp.zeros((B,) if slot_mode else (), jnp.int32))
+        if slot_mode:
+            idx = ci.value[slot_ids]                      # (B,) per-slot
+            rows_k = ck.value[slot_ids]                   # (B, S, h, hd)
+            rows_v = cv.value[slot_ids]
+            write = jax.vmap(
+                lambda row, new, off: lax.dynamic_update_slice(
+                    row, new, (off, 0, 0)))
+            rows_k = write(rows_k, k.astype(ck.value.dtype), idx)
+            rows_v = write(rows_v, v.astype(cv.value.dtype), idx)
+            ck.value = ck.value.at[slot_ids].set(rows_k)
+            cv.value = cv.value.at[slot_ids].set(rows_v)
+            ci.value = ci.value.at[slot_ids].set(idx + T)
+            S = rows_k.shape[1]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, rows_k) / np.sqrt(head_dim)
+            q_pos = idx[:, None] + jnp.arange(T)[None, :]   # (B, T)
+            mask = jnp.arange(S)[None, None, :] <= q_pos[:, :, None]
+            scores = jnp.where(
+                mask[:, None], scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            probs = probs.astype(cfg.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs, rows_v)
         idx = ci.value
         k_all = lax.dynamic_update_slice(
             ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0))
@@ -214,9 +247,12 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, deterministic: bool = True,
-                 return_hidden: bool = False, decode: bool = False):
+                 return_hidden: bool = False, decode: bool = False,
+                 slot_ids=None):
         cfg = self.cfg
         B, T = tokens.shape
+        if slot_ids is not None and not decode:
+            raise ValueError("slot_ids only applies to decode=True calls")
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -233,12 +269,24 @@ class GPT2(nn.Module):
             # KV-cache decode (serve path): positions continue from where
             # the cache left off.  The init call (full max-length input)
             # fixes the cache geometry; apply calls advance ``position``.
+            # With ``slot_ids`` (continuous batching) ``position`` is a
+            # per-slot (num_slots,) vector — each row of the call gets its
+            # own wpe offset and only its slots' entries advance.
             pos = self.variable(
-                "cache", "position", lambda: jnp.zeros((), jnp.int32))
-            offset = pos.value
-            x = wte[tokens].astype(cfg.dtype) + lax.dynamic_slice(
-                wpe, (offset, 0), (T, cfg.d_model)).astype(cfg.dtype)
-            pos.value = offset + T
+                "cache", "position",
+                lambda: jnp.zeros((B,) if slot_ids is not None else (),
+                                  jnp.int32))
+            if slot_ids is not None:
+                offset = pos.value[slot_ids]              # (B,)
+                positions = offset[:, None] + jnp.arange(T)[None, :]
+                x = (wte[tokens].astype(cfg.dtype)
+                     + wpe[positions].astype(cfg.dtype))
+                pos.value = pos.value.at[slot_ids].set(offset + T)
+            else:
+                offset = pos.value
+                x = wte[tokens].astype(cfg.dtype) + lax.dynamic_slice(
+                    wpe, (offset, 0), (T, cfg.d_model)).astype(cfg.dtype)
+                pos.value = offset + T
         else:
             x = wte[tokens].astype(cfg.dtype) + wpe[:T].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
@@ -270,19 +318,20 @@ class GPT2(nn.Module):
                 body,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,  # slot_ids is shared by every layer
                 length=cfg.n_layer,
                 unroll=cfg.scan_unroll,
             )
             x, _ = Scanned(
                 cfg, mesh=self.mesh, deterministic=deterministic,
                 decode=decode, name="blocks",
-            )(x)
+            )(x, slot_ids)
         else:
             for i in range(cfg.n_layer):
                 x, _ = Block(
                     cfg, mesh=self.mesh, deterministic=deterministic,
                     decode=decode, name=f"h_{i}",
-                )(x)
+                )(x, slot_ids)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             # Chunked-CE path: the loss computes logits per T-chunk itself

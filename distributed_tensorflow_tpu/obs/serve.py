@@ -44,18 +44,41 @@ class ServeMonitorHook(Hook):
         return {f"serve_{k}": v for k, v in s.items()}
 
     def log(self, step: int) -> Optional[Dict[str, float]]:
-        """Standalone export: log the snapshot, return the metrics dict."""
+        """Standalone export: log the snapshot, return the metrics dict.
+
+        Continuous-batching sources (``ContinuousScheduler`` or a
+        ``DynamicBatcher(iteration_level=True)``) carry the
+        iteration-level counters — slot occupancy, admissions/retirements
+        per step, TTFT/TPOT — and get the richer log line."""
         s = self._snapshot()
         if s is None:
             return None
-        logger.info(
-            "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "
-            "occupancy=%.2f p50=%.1fms p99=%.1fms",
-            step, int(s.get("queue_depth", 0)), int(s.get("capacity", 0)),
-            int(s.get("completed", 0)), int(s.get("rejected", 0)),
-            int(s.get("batches", 0)), s.get("avg_batch_occupancy", 0.0),
-            s.get("p50_latency_ms", 0.0), s.get("p99_latency_ms", 0.0),
-        )
+        if "slot_occupancy" in s:
+            logger.info(
+                "serve @ %d: depth=%d/%d done=%d rej=%d iters=%d "
+                "slots=%d/%d occupancy=%.2f adm/it=%.2f ret/it=%.2f "
+                "ttft_p50=%.1fms ttft_p99=%.1fms tpot=%.2fms "
+                "p50=%.1fms p99=%.1fms",
+                step, int(s.get("queue_depth", 0)),
+                int(s.get("capacity", 0)), int(s.get("completed", 0)),
+                int(s.get("rejected", 0)), int(s.get("iterations", 0)),
+                int(s.get("active_slots", 0)), int(s.get("num_slots", 0)),
+                s.get("slot_occupancy", 0.0),
+                s.get("admissions_per_iter", 0.0),
+                s.get("retirements_per_iter", 0.0),
+                s.get("ttft_p50_ms", 0.0), s.get("ttft_p99_ms", 0.0),
+                s.get("tpot_mean_ms", 0.0),
+                s.get("p50_latency_ms", 0.0), s.get("p99_latency_ms", 0.0),
+            )
+        else:
+            logger.info(
+                "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "
+                "occupancy=%.2f p50=%.1fms p99=%.1fms",
+                step, int(s.get("queue_depth", 0)), int(s.get("capacity", 0)),
+                int(s.get("completed", 0)), int(s.get("rejected", 0)),
+                int(s.get("batches", 0)), s.get("avg_batch_occupancy", 0.0),
+                s.get("p50_latency_ms", 0.0), s.get("p99_latency_ms", 0.0),
+            )
         return {f"serve_{k}": v for k, v in s.items()}
 
     # -- TrainLoop-embedded usage (same shape as PrefetchMonitorHook) --------

@@ -6,20 +6,28 @@ Layers:
 
 - ``engine``: restore + re-shard + jitted forward (``ServeEngine``);
 - ``batcher``: request coalescing, bucketed shapes, backpressure
-  (``DynamicBatcher`` / ``ServeOverloadedError``);
+  (``DynamicBatcher`` / ``ServeOverloadedError``); its
+  ``iteration_level=True`` mode streams requests to the continuous
+  scheduler instead of flushing fixed buckets;
+- ``continuous``: Orca-style iteration-level decode scheduling over ONE
+  resident KV cache (``ContinuousScheduler``) — admit into free slots,
+  one (num_slots, 1) step per iteration, retire mid-flight;
 - ``driver``: the in-process request loop behind ``serve.py`` and
   ``bench.py --mode=serve`` (``run_serve`` / ``ServeArgs``);
-- ``obs.ServeMonitorHook`` exports the batcher's counters.
+- ``obs.ServeMonitorHook`` exports the batcher's/scheduler's counters
+  (queue depth, occupancy, TTFT/TPOT).
 """
 
 from distributed_tensorflow_tpu.serve.batcher import (
     DynamicBatcher,
     ServeOverloadedError,
 )
+from distributed_tensorflow_tpu.serve.continuous import ContinuousScheduler
 from distributed_tensorflow_tpu.serve.driver import ServeArgs, run_serve
 from distributed_tensorflow_tpu.serve.engine import ServeEngine, pad_rows
 
 __all__ = [
+    "ContinuousScheduler",
     "DynamicBatcher",
     "ServeArgs",
     "ServeEngine",

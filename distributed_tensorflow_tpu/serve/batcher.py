@@ -16,6 +16,15 @@ complete out of submission order by design.  Admission control is a hard
 bound: past ``max_queue_size`` pending requests, ``submit`` raises
 ``ServeOverloadedError`` immediately (backpressure to the caller) instead of
 growing the queue without bound.
+
+``iteration_level=True`` is the CONTINUOUS-batching admission mode: no
+scheduler thread, no buckets, no flush — ``submit`` streams each request
+straight into a ``ContinuousScheduler``'s admission queue
+(``serve.continuous``), which re-forms the decode batch every iteration.
+The client surface (submit -> Future, ``ServeOverloadedError``
+backpressure, ``stats()``, ``close()``) is unchanged, so callers swap
+scheduling disciplines without code changes; completion is out of
+submission order in both modes.
 """
 
 from __future__ import annotations
@@ -65,14 +74,36 @@ class DynamicBatcher:
 
     def __init__(
         self,
-        run_batch: Callable[[List[Any]], List[Any]],
+        run_batch: Optional[Callable[[List[Any]], List[Any]]] = None,
         *,
         max_batch_size: int = 8,
         batch_timeout_ms: float = 5.0,
         max_queue_size: int = 64,
         bucket_fn: Optional[Callable[[Any], Hashable]] = None,
+        iteration_level: bool = False,
+        scheduler: Optional[Any] = None,
         name: str = "serve",
     ):
+        if iteration_level:
+            # Streaming admission: feed the continuous scheduler's queue
+            # instead of flushing fixed buckets.  No scheduler thread here
+            # — the ContinuousScheduler owns the decode loop.
+            if scheduler is None:
+                raise ValueError(
+                    "iteration_level=True requires scheduler= (a "
+                    "serve.ContinuousScheduler)")
+            if run_batch is not None:
+                raise ValueError(
+                    "iteration_level=True streams requests to the "
+                    "scheduler; run_batch does not apply")
+            self._scheduler = scheduler
+            self._stopped = False
+            self._lock = threading.Lock()
+            return
+        self._scheduler = None
+        if run_batch is None:
+            raise ValueError("run_batch is required (unless "
+                             "iteration_level=True)")
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
         self._run_batch = run_batch
@@ -112,6 +143,11 @@ class DynamicBatcher:
         ``max_queue_size`` (admission control) and ``RuntimeError`` after
         ``close()``.
         """
+        if self._scheduler is not None:
+            with self._lock:
+                if self._stopped:
+                    raise RuntimeError("DynamicBatcher is closed")
+            return self._scheduler.submit_payload(payload)
         fut: Future = Future()
         with self._cond:
             if self._stopped:
@@ -132,7 +168,11 @@ class DynamicBatcher:
         return fut
 
     def stats(self) -> Dict[str, float]:
-        """Counter snapshot (the ServeMonitorHook export surface)."""
+        """Counter snapshot (the ServeMonitorHook export surface).  In
+        iteration-level mode this is the scheduler's snapshot — including
+        the continuous-batching counters (slot occupancy, TTFT/TPOT)."""
+        if self._scheduler is not None:
+            return self._scheduler.stats()
         with self._lock:
             lat = sorted(self._latencies_ms)
             batches = self._batches
@@ -158,6 +198,11 @@ class DynamicBatcher:
         Idempotent.  The in-flight batch (if any) finishes first — its
         futures resolve normally.
         """
+        if self._scheduler is not None:
+            with self._lock:
+                self._stopped = True
+            self._scheduler.close(timeout)
+            return
         with self._cond:
             if self._stopped:
                 return

@@ -1,0 +1,355 @@
+"""Continuous batching: Orca-style iteration-level decode scheduling.
+
+The fixed-batch path (``ServeEngine.generate`` behind ``DynamicBatcher``)
+batches at REQUEST granularity: every row in a flushed batch decodes for the
+full shared horizon before any result returns, and newly-arrived requests
+wait for the whole batch to drain.  ``ContinuousScheduler`` re-forms the
+batch every decode step instead (Yu et al., OSDI 2022 — PAPERS.md):
+
+- ONE resident KV cache of shape ``(num_slots, max_total_len)`` lives for
+  the scheduler's lifetime (``ServeEngine.init_slot_cache``); requests are
+  admitted into free slots and retired out of them mid-flight, vLLM-style
+  slot/cache reuse discipline (Kwon et al., SOSP 2023).
+- Each iteration: (a) ADMIT queued requests into free slots via slot-local
+  prefill (``prefill_into_slots`` resets the slot's index rows and writes
+  the prompt's K/V at that slot's rows — stale K/V from the previous
+  occupant stays masked behind the reset index); (b) run ONE
+  ``(num_slots, 1)`` decode step over all slots (``decode_slots``) with an
+  active-mask so empty slots are free compute; (c) RETIRE slots whose row
+  hit its eos token or its per-request ``max_new_tokens``, resolving that
+  request's Future immediately — no request ever waits on another's
+  horizon.
+
+Completion is out of submission order by design.  The per-request metrics
+this unlocks — time-to-first-token (submit -> prefill token) and
+time-per-output-token (decode cadence) — are first-class in ``stats()``,
+exported by ``obs.ServeMonitorHook``.
+
+Admission control mirrors ``DynamicBatcher``: a bounded queue that rejects
+with ``ServeOverloadedError`` instead of growing tail latency unboundedly.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.serve.batcher import (
+    ServeOverloadedError,
+    _percentile,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _SlotRequest:
+    """Per-slot state for one in-flight request."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token: Optional[int]
+    future: Future
+    submitted: float                 # time.monotonic() at submit
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+
+    def done(self) -> bool:
+        if len(self.tokens) >= self.max_new_tokens:
+            return True
+        return (self.eos_token is not None and len(self.tokens) > 0
+                and self.tokens[-1] == self.eos_token)
+
+
+class ContinuousScheduler:
+    """Persistent decode loop owning one resident KV cache.
+
+    ``submit`` enqueues a request and returns a Future resolving to its
+    1-D generated-token array (ending at its eos token if one was hit).
+    One scheduler thread runs admit -> decode -> retire iterations for the
+    scheduler's lifetime; it sleeps only while no request is active or
+    queued.
+
+    ``num_slots`` is rounded up to the engine's bucketed shapes (a
+    multiple of the mesh's data-parallel extent — slot rows shard over the
+    data axes).  ``max_total_len`` bounds prompt + generated length per
+    slot; admission validates it per request.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        num_slots: int = 8,
+        max_total_len: Optional[int] = None,
+        max_queue_size: int = 64,
+        eos_token: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        name: str = "serve-continuous",
+        start: bool = True,
+    ):
+        cfg = getattr(engine.module, "cfg", None)
+        if cfg is None:
+            raise ValueError(
+                "ContinuousScheduler serves the KV-cache decode path; "
+                f"model {engine.model!r} has no decode cache")
+        self.engine = engine
+        self.num_slots = engine.bucket_rows(max(1, num_slots))
+        self.max_total_len = int(max_total_len or cfg.n_positions)
+        self.max_queue_size = max_queue_size
+        self.eos_token = eos_token
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._cache = engine.init_slot_cache(
+            self.num_slots, self.max_total_len)
+        self._free: List[int] = list(range(self.num_slots))
+        self._active: Dict[int, _SlotRequest] = {}
+        self._last_tok = np.zeros((self.num_slots, 1), np.int32)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "collections.deque[_SlotRequest]" = collections.deque()
+        self._stopped = False
+        # counters (under _lock)
+        self._submitted = 0
+        self._rejected = 0
+        self._completed = 0
+        self._failed = 0
+        self._admitted = 0
+        self._retired = 0
+        self._iterations = 0
+        self._decode_counter = 0  # fold_in counter for the in-step RNG
+        self._occupancy_sum = 0
+        self._last_occupancy = 0
+        self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
+        self._ttft_ms: collections.deque = collections.deque(maxlen=1024)
+        self._tpot_ms: collections.deque = collections.deque(maxlen=1024)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=name)
+        if start:
+            self._thread.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, *,
+               max_new_tokens: int = 16,
+               eos_token: Optional[int] = None) -> Future:
+        """Enqueue one prompt; Future resolves to its 1-D token array the
+        moment ITS slot retires (out of submission order by design).
+
+        Raises ``ServeOverloadedError`` when the admission queue is at
+        ``max_queue_size`` and ``RuntimeError`` after ``close()``.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_total_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_total_len {self.max_total_len}")
+        req = _SlotRequest(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            eos_token=self.eos_token if eos_token is None else eos_token,
+            future=Future(), submitted=time.monotonic())
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("ContinuousScheduler is closed")
+            if len(self._queue) >= self.max_queue_size:
+                self._rejected += 1
+                raise ServeOverloadedError(
+                    f"admission queue full ({len(self._queue)}/"
+                    f"{self.max_queue_size} queued); back off and retry")
+            self._queue.append(req)
+            self._submitted += 1
+            self._cond.notify()
+        return req.future
+
+    def submit_payload(self, payload: Any) -> Future:
+        """``DynamicBatcher(iteration_level=True)`` adapter: a raw array is
+        a prompt; a dict carries ``prompt`` plus per-request options; a
+        (prompt, max_new_tokens) tuple is the driver's mixed-traffic
+        shape."""
+        if isinstance(payload, dict):
+            return self.submit(payload["prompt"], **{
+                k: v for k, v in payload.items() if k != "prompt"})
+        if isinstance(payload, tuple) and len(payload) == 2:
+            return self.submit(payload[0], max_new_tokens=int(payload[1]))
+        return self.submit(payload)
+
+    def stats(self) -> Dict[str, float]:
+        """Counter snapshot (ServeMonitorHook export surface).  Includes
+        the iteration-level counters: slot occupancy, admissions /
+        retirements per iteration, TTFT / TPOT percentiles."""
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            ttft = sorted(self._ttft_ms)
+            tpot = self._tpot_ms
+            iters = self._iterations
+            return {
+                "queue_depth": float(len(self._queue)),
+                "capacity": float(self.max_queue_size),
+                "submitted": float(self._submitted),
+                "completed": float(self._completed),
+                "rejected": float(self._rejected),
+                "failed": float(self._failed),
+                "num_slots": float(self.num_slots),
+                "active_slots": float(len(self._active)),
+                "admitted": float(self._admitted),
+                "retired": float(self._retired),
+                "iterations": float(iters),
+                "slot_occupancy": (
+                    self._occupancy_sum / (iters * self.num_slots)
+                    if iters else 0.0),
+                "last_occupancy": float(self._last_occupancy),
+                "admissions_per_iter": (
+                    self._admitted / iters if iters else 0.0),
+                "retirements_per_iter": (
+                    self._retired / iters if iters else 0.0),
+                "p50_latency_ms": _percentile(lat, 0.50),
+                "p99_latency_ms": _percentile(lat, 0.99),
+                "ttft_p50_ms": _percentile(ttft, 0.50),
+                "ttft_p99_ms": _percentile(ttft, 0.99),
+                "tpot_mean_ms": (sum(tpot) / len(tpot)) if tpot else 0.0,
+            }
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the loop; fail queued and in-flight futures.  Idempotent.
+        The iteration in progress finishes first — its retirements resolve
+        normally."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        with self._cond:
+            leftover = list(self._queue) + list(self._active.values())
+            self._queue.clear()
+            self._active.clear()
+            self._free = list(range(self.num_slots))
+        for req in leftover:
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("ContinuousScheduler closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- the persistent decode loop ------------------------------------------
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                admits: List[_SlotRequest] = []
+                with self._cond:
+                    while (not self._stopped and not self._active
+                           and not self._queue):
+                        self._cond.wait()
+                    if self._stopped:
+                        return
+                    while self._queue and self._free:
+                        req = self._queue.popleft()
+                        req.slot = self._free.pop()
+                        admits.append(req)
+                self._admit(admits)
+                self._decode_once()
+        except BaseException as e:  # noqa: BLE001 — forwarded to futures
+            logger.exception("continuous scheduler loop died")
+            with self._cond:
+                self._stopped = True
+                doomed = (list(self._queue) + list(self._active.values()))
+                self._queue.clear()
+                self._active.clear()
+                self._failed += len(doomed)
+            for req in doomed:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _admit(self, admits: List[_SlotRequest]) -> None:
+        """Slot-local prefill per admitted request.  Prompts are prefilled
+        one request at a time — each (1, T_prompt) program compiles once
+        per prompt length, and a single-row prefill touches only that
+        slot's rows of the resident cache."""
+        now = time.monotonic()
+        for req in admits:
+            tok_dev, self._cache = self.engine.prefill_into_slots(
+                self._cache, req.prompt[None, :], [req.slot],
+                temperature=self.temperature, top_k=self.top_k,
+                counter=self._next_counter())
+            tok = int(np.asarray(jax.device_get(tok_dev))[0])
+            req.first_token_at = time.monotonic()
+            req.tokens.append(tok)
+            self._last_tok[req.slot, 0] = tok
+            with self._lock:
+                self._admitted += 1
+                self._active[req.slot] = req
+            logger.debug("admitted request into slot %d (prompt %d, ttft "
+                         "%.1fms)", req.slot, len(req.prompt),
+                         (req.first_token_at - req.submitted) * 1e3)
+            if req.done():  # max_new_tokens == 1 or instant eos
+                self._retire(req)
+        del now
+
+    def _decode_once(self) -> None:
+        """One iteration: a (num_slots, 1) step over all slots, then
+        retirement of every row that hit its eos or horizon."""
+        with self._lock:
+            active_slots = list(self._active)
+        if not active_slots:
+            return
+        active = np.zeros((self.num_slots,), bool)
+        active[active_slots] = True
+        tok_dev, self._cache = self.engine.decode_slots(
+            self._cache, self._last_tok, active,
+            temperature=self.temperature, top_k=self.top_k,
+            counter=self._next_counter())
+        toks = np.asarray(jax.device_get(tok_dev))
+        with self._lock:
+            self._iterations += 1
+            self._occupancy_sum += len(active_slots)
+            self._last_occupancy = len(active_slots)
+        for slot in active_slots:
+            req = self._active[slot]
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            self._last_tok[slot, 0] = tok
+            if req.done():
+                self._retire(req)
+
+    def _next_counter(self) -> int:
+        with self._lock:
+            self._decode_counter += 1
+            return self._decode_counter
+
+    def _retire(self, req: _SlotRequest) -> None:
+        req.finished_at = time.monotonic()
+        with self._lock:
+            self._active.pop(req.slot, None)
+            self._free.append(req.slot)
+            self._retired += 1
+            self._completed += 1
+            self._latencies_ms.append(
+                (req.finished_at - req.submitted) * 1e3)
+            if req.first_token_at is not None:
+                self._ttft_ms.append(
+                    (req.first_token_at - req.submitted) * 1e3)
+                if len(req.tokens) > 1:
+                    self._tpot_ms.append(
+                        (req.finished_at - req.first_token_at) * 1e3
+                        / (len(req.tokens) - 1))
+        req.future.set_result(np.asarray(req.tokens, np.int32))

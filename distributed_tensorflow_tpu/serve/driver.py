@@ -7,9 +7,26 @@ client threads submitting through ``DynamicBatcher`` exercise the same
 coalescing/backpressure behavior a frontend would, without a transport
 dependency in the repo.
 
-Reported numbers: decoded tokens/sec (gpt2) or classified examples/sec,
-plus per-request latency percentiles straight from the batcher's counters —
-the serving analogue of the bench's images/sec/chip line.
+Two scheduling disciplines, same client loop:
+
+- fixed-batch (default): ``DynamicBatcher`` coalesces shape-uniform
+  buckets, each flushed batch decodes the full shared horizon
+  (``ServeEngine.generate_batch``);
+- ``continuous=True``: ``DynamicBatcher(iteration_level=True)`` streams
+  requests into a ``ContinuousScheduler`` that re-forms the decode batch
+  every step over ONE resident KV cache — short requests retire
+  immediately and new ones are admitted into their slots mid-flight.
+
+Traffic is MIXED by default where it matters: ``prompt_lens`` cycles
+prompt lengths and ``min_new_tokens`` (when set below ``max_new_tokens``)
+cycles per-request horizons — the workload where iteration-level
+scheduling beats request-level batching (short requests no longer pay for
+the longest row in their batch).
+
+Reported numbers: delivered tokens/sec (gpt2) or classified examples/sec,
+per-request latency percentiles, and — under the continuous scheduler —
+time-to-first-token percentiles, mean time-per-output-token and slot
+occupancy, straight from the scheduler's counters.
 """
 
 from __future__ import annotations
@@ -18,7 +35,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -28,6 +45,7 @@ from distributed_tensorflow_tpu.serve.batcher import (
     DynamicBatcher,
     ServeOverloadedError,
 )
+from distributed_tensorflow_tpu.serve.continuous import ContinuousScheduler
 from distributed_tensorflow_tpu.serve.engine import ServeEngine
 
 logger = logging.getLogger(__name__)
@@ -42,9 +60,22 @@ class ServeArgs:
     batch_timeout_ms: float = 5.0
     max_queue_size: int = 64
     max_new_tokens: int = 16
+    # 0 = every request decodes max_new_tokens; >0 = per-request horizons
+    # cycle between min and max (mixed traffic — the continuous scheduler's
+    # home turf).
+    min_new_tokens: int = 0
     prompt_len: int = 16
+    # comma-separated prompt lengths to cycle ("8,16,24"); empty = uniform
+    # prompt_len.
+    prompt_lens: str = ""
     clients: int = 4
     preset: Optional[str] = None  # gpt2 config preset; None = auto by platform
+    # continuous batching (serve/continuous.py)
+    continuous: bool = False
+    num_slots: int = 8
+    # sampling (greedy argmax when temperature == 0)
+    temperature: float = 0.0
+    top_k: int = 0
     # mesh axes (data=-1 absorbs the rest, as in train.py)
     data: int = -1
     fsdp: int = 1
@@ -64,59 +95,145 @@ def _auto_preset(args: ServeArgs) -> Optional[str]:
     return "medium" if jax.devices()[0].platform == "tpu" else "tiny"
 
 
-def _make_requests(args: ServeArgs, engine: ServeEngine, rng: np.random.Generator):
-    """One synthetic payload per request."""
+def _horizons(args: ServeArgs) -> List[int]:
+    """Per-request max_new_tokens cycle for mixed traffic."""
+    hi = args.max_new_tokens
+    lo = args.min_new_tokens
+    if lo <= 0 or lo >= hi:
+        return [hi]
+    return [hi, lo, max(lo, (lo + hi) // 2), hi]
+
+
+def _prompt_lengths(args: ServeArgs) -> List[int]:
+    if not args.prompt_lens:
+        return [args.prompt_len]
+    lens = [int(x) for x in args.prompt_lens.split(",") if x.strip()]
+    return lens or [args.prompt_len]
+
+
+def _make_requests(args: ServeArgs, engine: ServeEngine,
+                   rng: np.random.Generator):
+    """One synthetic payload per request.  gpt2 payloads are (prompt,
+    max_new_tokens) tuples — both paths serve the SAME mixed traffic."""
     if args.model == "gpt2":
         vocab = engine.module.cfg.vocab_size
-        return [rng.integers(0, vocab, size=(args.prompt_len,), dtype=np.int32)
-                for _ in range(args.steps)]
+        lens = _prompt_lengths(args)
+        horizons = _horizons(args)
+        return [
+            (rng.integers(0, vocab, size=(lens[i % len(lens)],),
+                          dtype=np.int32),
+             horizons[i % len(horizons)])
+            for i in range(args.steps)
+        ]
     batch = next(engine.workload.data_fn(max(2, args.max_batch_size)))
     n = len(next(iter(batch.values())))
     return [{k: np.asarray(v[i % n]) for k, v in batch.items()
              if k != "label"} for i in range(args.steps)]
 
 
-def run_serve(args: ServeArgs) -> Dict[str, Any]:
-    """Drive ``args.steps`` requests; returns the serve metrics dict."""
-    mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(
-        data=args.data, fsdp=args.fsdp, tensor=args.tensor))
-    overrides: Dict[str, Any] = {}
-    preset = _auto_preset(args)
-    if preset:
-        overrides["preset"] = preset
-    engine = ServeEngine(
-        args.model, mesh=mesh, checkpoint_dir=args.checkpoint_dir,
-        seed=args.seed, **overrides)
+def run_serve(args: ServeArgs,
+              engine: Optional[ServeEngine] = None) -> Dict[str, Any]:
+    """Drive ``args.steps`` requests; returns the serve metrics dict.
+
+    Pass ``engine`` to reuse one restored/compiled engine across runs
+    (``bench.py --mode=serve`` compares both scheduling disciplines on the
+    same engine this way)."""
+    own_engine = engine is None
+    if own_engine:
+        mesh = cluster_lib.build_mesh(cluster_lib.MeshConfig(
+            data=args.data, fsdp=args.fsdp, tensor=args.tensor))
+        overrides: Dict[str, Any] = {}
+        preset = _auto_preset(args)
+        if preset:
+            overrides["preset"] = preset
+        engine = ServeEngine(
+            args.model, mesh=mesh, checkpoint_dir=args.checkpoint_dir,
+            seed=args.seed, **overrides)
     try:
         return _drive(args, engine)
     finally:
-        engine.close()
+        if own_engine:
+            engine.close()
+
+
+def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
+    """The scheduling discipline behind one run: fixed buckets or
+    iteration-level streaming into a continuous scheduler."""
+    if args.model != "gpt2":
+        return DynamicBatcher(
+            engine.classify_batch,
+            max_batch_size=args.max_batch_size,
+            batch_timeout_ms=args.batch_timeout_ms,
+            max_queue_size=args.max_queue_size,
+        )
+    if args.continuous:
+        cfg = engine.module.cfg
+        need = max(p.shape[0] + m for p, m in
+                   _make_requests(args, engine, np.random.default_rng(0)))
+        scheduler = ContinuousScheduler(
+            engine,
+            num_slots=args.num_slots,
+            max_total_len=min(cfg.n_positions, need),
+            max_queue_size=args.max_queue_size,
+            temperature=args.temperature,
+            top_k=args.top_k,
+        )
+        return DynamicBatcher(iteration_level=True, scheduler=scheduler)
+
+    def run_batch(payloads: List[Tuple[np.ndarray, int]]) -> List[Any]:
+        # Request-level batching decodes the SHARED horizon for the whole
+        # batch and slices each row to its own request — exactly the
+        # short-pays-for-long cost continuous batching removes.
+        gen = engine.generate_batch(
+            [p for p, _ in payloads], args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k)
+        return [g[:m] for (_, m), g in zip(payloads, gen)]
+
+    return DynamicBatcher(
+        run_batch,
+        max_batch_size=args.max_batch_size,
+        batch_timeout_ms=args.batch_timeout_ms,
+        max_queue_size=args.max_queue_size,
+        bucket_fn=lambda payload: len(payload[0]),
+    )
+
+
+def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
+    """Compile outside the timed window: the fixed path warms the padded
+    full-batch prefill+decode programs; the continuous path warms the
+    slot prefill (per prompt length) and the (num_slots, 1) step."""
+    if args.model != "gpt2":
+        engine.classify_batch(payloads[: min(len(payloads),
+                                             args.max_batch_size)])
+        return
+    if args.continuous:
+        warm_sched = ContinuousScheduler(
+            engine, num_slots=args.num_slots,
+            max_total_len=min(engine.module.cfg.n_positions,
+                              max(p.shape[0] + m for p, m in payloads)),
+            temperature=args.temperature, top_k=args.top_k)
+        futs = {}
+        for length in sorted({p.shape[0] for p, _ in payloads}):
+            prompt = next(p for p, _ in payloads if p.shape[0] == length)
+            futs[length] = warm_sched.submit(prompt, max_new_tokens=2)
+        for f in futs.values():
+            f.result(timeout=600.0)
+        warm_sched.close()
+        return
+    warm = payloads[: min(len(payloads), args.max_batch_size)]
+    gen = engine.generate_batch(
+        [p for p, _ in warm], args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k)
+    del gen
 
 
 def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
     is_lm = args.model == "gpt2"
-    if is_lm:
-        run_batch = lambda ps: engine.generate_batch(ps, args.max_new_tokens)  # noqa: E731
-        bucket_fn = len  # prompt length => shape-uniform batches
-    else:
-        run_batch = engine.classify_batch
-        bucket_fn = None
+    _warm(args, engine, payloads)
 
-    # Warm the jitted programs (prefill + decode / predict) outside the
-    # timed window — the padded full-batch shape is the one every flushed
-    # batch lands on.
-    warm = payloads[: min(len(payloads), args.max_batch_size)]
-    run_batch(warm)
-
-    batcher = DynamicBatcher(
-        run_batch,
-        max_batch_size=args.max_batch_size,
-        batch_timeout_ms=args.batch_timeout_ms,
-        max_queue_size=args.max_queue_size,
-        bucket_fn=bucket_fn,
-    )
+    batcher = _make_batcher(args, engine)
     monitor = ServeMonitorHook(batcher, every_steps=args.log_every)
     futures: List[Any] = [None] * len(payloads)
     rejected = [0]
@@ -153,22 +270,35 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
     completed = int(stats["completed"])
     out: Dict[str, Any] = {
         "model": args.model,
+        "scheduler": ("continuous" if is_lm and args.continuous
+                      else "fixed_batch"),
         "requests": args.steps,
         "completed": completed,
         "rejected_retries": rejected[0],
         "elapsed_s": round(elapsed, 4),
         "p50_latency_ms": round(stats["p50_latency_ms"], 3),
         "p99_latency_ms": round(stats["p99_latency_ms"], 3),
-        "avg_batch_occupancy": round(stats["avg_batch_occupancy"], 3),
-        "batches": int(stats["batches"]),
         "checkpoint_step": engine.restored_step,
     }
+    if is_lm and args.continuous:
+        out["slot_occupancy"] = round(stats["slot_occupancy"], 4)
+        out["num_slots"] = int(stats["num_slots"])
+        out["iterations"] = int(stats["iterations"])
+        out["admissions_per_iter"] = round(stats["admissions_per_iter"], 3)
+        out["retirements_per_iter"] = round(stats["retirements_per_iter"], 3)
+        out["ttft_p50_ms"] = round(stats["ttft_p50_ms"], 3)
+        out["ttft_p99_ms"] = round(stats["ttft_p99_ms"], 3)
+        out["tpot_mean_ms"] = round(stats["tpot_mean_ms"], 4)
+    else:
+        out["avg_batch_occupancy"] = round(
+            stats.get("avg_batch_occupancy", 0.0), 3)
+        out["batches"] = int(stats.get("batches", 0))
     if is_lm:
-        out["tokens_generated"] = completed * args.max_new_tokens
-        out["tokens_per_sec"] = round(
-            completed * args.max_new_tokens / max(elapsed, 1e-9), 2)
-        # Sanity surface for smoke tests: every result is a full generation.
-        assert all(len(r) == args.max_new_tokens for r in results)
+        delivered = int(sum(len(r) for r in results))
+        out["tokens_generated"] = delivered
+        out["tokens_per_sec"] = round(delivered / max(elapsed, 1e-9), 2)
+        # Sanity surface for smoke tests: every result honors its horizon.
+        assert all(len(r) == m for r, (_, m) in zip(results, payloads))
     else:
         out["examples_per_sec"] = round(completed / max(elapsed, 1e-9), 2)
         out["predictions"] = results[: min(8, len(results))]

@@ -22,6 +22,7 @@ batch split.
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Any, Callable, Dict, List, Optional
 
@@ -41,6 +42,27 @@ logger = logging.getLogger(__name__)
 PyTree = Any
 
 
+def _select_next(logits: jax.Array, rng, counter, temperature: float,
+                 top_k: int) -> jax.Array:
+    """Next-token selection over (B, V) last-position logits.
+
+    ``temperature <= 0`` is greedy argmax (the default, and what every
+    parity test pins).  Otherwise temperature/top-k sampling with the
+    in-step RNG pattern (async-loop contract, PR 1): the caller passes ONE
+    base key plus a step counter and the per-step key is derived by
+    ``fold_in`` INSIDE the compiled program — no host-side split per token,
+    so the decode dispatch loop stays sync-free.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
+        scaled = jnp.where(scaled < kth, jnp.finfo(jnp.float32).min, scaled)
+    key = jax.random.fold_in(rng, jnp.asarray(counter).astype(jnp.uint32))
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 def pad_rows(arr: np.ndarray, target: int) -> np.ndarray:
     """Pad the leading (batch) dim to ``target`` rows by repeating the last
     row — inert filler whose outputs the caller slices off."""
@@ -51,6 +73,15 @@ def pad_rows(arr: np.ndarray, target: int) -> np.ndarray:
         raise ValueError(f"batch {n} exceeds padded target {target}")
     pad = np.repeat(arr[-1:], target - n, axis=0)
     return np.concatenate([arr, pad], axis=0)
+
+
+def _trim_at_eos(row: np.ndarray, eos_token: Optional[int]) -> np.ndarray:
+    """Cut a generated row just past its first eos (inclusive); unchanged
+    when ``eos_token`` is None or never emitted."""
+    if eos_token is None:
+        return row
+    hits = np.flatnonzero(row == eos_token)
+    return row if hits.size == 0 else row[: int(hits[0]) + 1]
 
 
 class ServeEngine:
@@ -80,6 +111,9 @@ class ServeEngine:
         self._generate_fns: Dict[Any, Callable] = {}
         self._cache_init_fns: Dict[Any, Callable] = {}
         self.restored_step: Optional[int] = None
+        # Base sampling key (in-step RNG: folded with a step counter inside
+        # the compiled step, never split on the host per token).
+        self._sample_rng = jax.random.fold_in(jax.random.key(seed), 0x53)
 
         def init_fn():
             init_input = (
@@ -138,6 +172,32 @@ class ServeEngine:
         next_tokens = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
         return next_tokens, mutated["cache"]
 
+    def _sampled_decode_apply(self, temperature, top_k, params, cache,
+                              tokens, rng, counter):
+        logits, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens,
+            decode=True, mutable=["cache"],
+        )
+        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
+        return nxt, mutated["cache"]
+
+    def _decode_step_fn(self, temperature: float, top_k: int) -> Callable:
+        """Jitted fixed-batch decode step for one sampling config.  The
+        greedy program is EXACTLY the pre-sampling one (no rng/counter
+        arguments), so the default path stays bit-identical."""
+        if temperature <= 0.0:
+            if "step" not in self._generate_fns:
+                self._generate_fns["step"] = jax.jit(
+                    self._decode_apply, donate_argnums=(1,))
+            return self._generate_fns["step"]
+        key = ("step", float(temperature), int(top_k))
+        if key not in self._generate_fns:
+            self._generate_fns[key] = jax.jit(
+                functools.partial(self._sampled_decode_apply,
+                                  float(temperature), int(top_k)),
+                donate_argnums=(1,))
+        return self._generate_fns[key]
+
     def init_cache(self, batch: int, total_len: int) -> PyTree:
         """Preallocated, sharded KV cache for ``batch`` rows of up to
         ``total_len`` (prompt + generated) tokens."""
@@ -160,8 +220,144 @@ class ServeEngine:
             )
         return self._cache_init_fns[key]()
 
-    def generate(self, prompts: np.ndarray, max_new_tokens: int) -> np.ndarray:
-        """Greedy decode: (B, T_prompt) int32 -> (B, max_new_tokens) int32.
+    # -- resident slot cache (continuous batching) ---------------------------
+
+    def init_slot_cache(self, num_slots: int, total_len: int) -> PyTree:
+        """ONE resident KV cache for the continuous scheduler's lifetime:
+        ``(num_slots, total_len)`` K/V geometry with PER-SLOT
+        ``(num_slots,)`` ``cache_index``/``position`` vectors (the model's
+        ``slot_ids`` path), sharded exactly like the fixed-batch cache
+        (slots over the data axes, heads over ``tensor``)."""
+        from distributed_tensorflow_tpu.models.gpt2 import gpt2_cache_rules
+
+        dp = max(1, self.data_parallelism)
+        if num_slots < 1 or num_slots % dp:
+            raise ValueError(
+                f"num_slots {num_slots} must be a positive multiple of the "
+                f"data-parallel extent {dp} (slot rows shard over data)")
+        cfg = getattr(self.module, "cfg", None)
+        if cfg is not None and total_len > cfg.n_positions:
+            raise ValueError(
+                f"max_total_len {total_len} exceeds n_positions "
+                f"{cfg.n_positions}")
+        key = ("slots", num_slots, total_len)
+        if key not in self._cache_init_fns:
+            def mk():
+                vs = self.module.init(
+                    jax.random.key(0),
+                    jnp.zeros((num_slots, total_len), jnp.int32),
+                    decode=True,
+                    slot_ids=jnp.arange(num_slots, dtype=jnp.int32))
+                return vs["cache"]
+
+            shapes = jax.eval_shape(mk)
+            shardings = gpt2_cache_rules().shardings_for(self.mesh, shapes)
+            self._cache_init_fns[key] = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+                out_shardings=shardings,
+            )
+        return self._cache_init_fns[key]()
+
+    @staticmethod
+    def _reset_slot_rows(cache: PyTree, slot_ids) -> PyTree:
+        """Zero ``cache_index``/``position`` rows for ``slot_ids`` — slot
+        reuse hygiene: a freshly admitted request must not inherit the
+        previous occupant's offsets.  K/V rows need no zeroing: the causal
+        mask hides everything past the (reset) index, and prefill
+        overwrites from position 0."""
+        def _one(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in ("cache_index", "position"):
+                return leaf.at[..., slot_ids].set(0)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(_one, cache)
+
+    def _prefill_slots_apply(self, temperature, top_k, params, cache,
+                             tokens, slot_ids, rng, counter):
+        cache = self._reset_slot_rows(cache, slot_ids)
+        logits, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens,
+            decode=True, slot_ids=slot_ids, mutable=["cache"],
+        )
+        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
+        return nxt, mutated["cache"]
+
+    def prefill_into_slots(self, cache: PyTree, prompts: np.ndarray,
+                           slot_ids: np.ndarray, *,
+                           temperature: float = 0.0, top_k: int = 0,
+                           rng=None, counter: int = 0):
+        """Admit requests: slot-local prefill writing each prompt's K/V
+        into its slot's rows of the RESIDENT cache (state rows reset
+        first), returning (first generated tokens (n,), updated cache).
+        ``prompts`` is (n, T_prompt) shape-uniform; ``slot_ids`` (n,)
+        unique free slots.  The cache is donated through the call."""
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim != 2:
+            raise ValueError(f"prompts must be (n, T), got {prompts.shape}")
+        key = ("slot_prefill", float(temperature), int(top_k))
+        if key not in self._generate_fns:
+            self._generate_fns[key] = jax.jit(
+                functools.partial(self._prefill_slots_apply,
+                                  float(temperature), int(top_k)),
+                donate_argnums=(1,))
+        base = rng if rng is not None else self._sample_rng
+        return self._generate_fns[key](
+            self.params, cache, prompts,
+            np.asarray(slot_ids, np.int32), base, counter)
+
+    def _decode_slots_apply(self, temperature, top_k, params, cache,
+                            tokens, active, rng, counter):
+        num_slots = tokens.shape[0]
+        slots = jnp.arange(num_slots, dtype=jnp.int32)
+        logits, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens,
+            decode=True, slot_ids=slots, mutable=["cache"],
+        )
+
+        # Active-mask: empty slots are free compute — the step runs over
+        # all (num_slots, 1) rows, but inactive slots' index rows must not
+        # advance (their state stays exactly as retirement left it; the
+        # garbage K/V an inactive row writes sits beyond its frozen index,
+        # so the causal mask never admits it).
+        def _gate(path, new, old):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in ("cache_index", "position"):
+                act = active if new.ndim == 1 else active[None, :]
+                return jnp.where(act, new, old)
+            return new
+
+        gated = jax.tree_util.tree_map_with_path(
+            _gate, mutated["cache"], cache)
+        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
+        return nxt, gated
+
+    def decode_slots(self, cache: PyTree, last_tokens: np.ndarray,
+                     active: np.ndarray, *, temperature: float = 0.0,
+                     top_k: int = 0, rng=None, counter: int = 0):
+        """One iteration-level decode step over ALL slots: (num_slots, 1)
+        tokens against the resident cache, per-slot offsets, inactive
+        slots gated by ``active``.  Returns (next tokens (num_slots,),
+        updated cache); the cache is donated through the call."""
+        key = ("slot_decode", float(temperature), int(top_k))
+        if key not in self._generate_fns:
+            self._generate_fns[key] = jax.jit(
+                functools.partial(self._decode_slots_apply,
+                                  float(temperature), int(top_k)),
+                donate_argnums=(1,))
+        base = rng if rng is not None else self._sample_rng
+        tokens_dev = jax.device_put(
+            np.asarray(last_tokens, np.int32), batch_sharding(self.mesh))
+        return self._generate_fns[key](
+            self.params, cache, tokens_dev,
+            np.asarray(active, bool), base, counter)
+
+    def generate(self, prompts: np.ndarray, max_new_tokens: int, *,
+                 eos_token: Optional[int] = None, eos_check_every: int = 8,
+                 temperature: float = 0.0, top_k: int = 0,
+                 rng=None) -> np.ndarray:
+        """Decode: (B, T_prompt) int32 -> (B, n <= max_new_tokens) int32.
 
         One prefill call over the whole prompt fills the cache and yields
         the first new token; each further token is a (B, 1) decode step
@@ -169,6 +365,15 @@ class ServeEngine:
         T_prompt) prefill and (B, 1) decode programs compile once per
         shape; the cache is donated through the step so decode updates it
         in place.
+
+        Defaults are greedy argmax for the full horizon — bit-identical to
+        the pre-sampling path.  ``temperature > 0`` (optionally with
+        ``top_k``) samples via the in-step RNG pattern (one base key, step
+        counter folded in on device).  ``eos_token`` enables early exit:
+        once every row has emitted it, decoding stops at the next host
+        check — checked every ``eos_check_every`` steps so the dispatch
+        loop is not synced per token.  Rows that finished earlier still
+        carry (ignorable) tokens after their eos.
         """
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim != 2:
@@ -182,25 +387,40 @@ class ServeEngine:
             raise ValueError(
                 f"prompt {T} + max_new_tokens {max_new_tokens} exceeds "
                 f"n_positions {cfg.n_positions}")
-        if "step" not in self._generate_fns:
-            self._generate_fns["step"] = jax.jit(
-                self._decode_apply, donate_argnums=(1,))
-        step = self._generate_fns["step"]
+        greedy = temperature <= 0.0
+        step = self._decode_step_fn(temperature, top_k)
+        base = rng if rng is not None else self._sample_rng
         cache = self.init_cache(B, total)
         tokens_dev = jax.device_put(prompts, batch_sharding(self.mesh))
-        tok, cache = step(self.params, cache, tokens_dev)
+        if greedy:
+            tok, cache = step(self.params, cache, tokens_dev)
+        else:
+            tok, cache = step(self.params, cache, tokens_dev, base, 0)
         out = [tok]
-        for _ in range(max_new_tokens - 1):
-            tok, cache = step(self.params, cache, tok[:, None])
+        done = (tok == eos_token) if eos_token is not None else None
+        check_every = max(1, eos_check_every)
+        for i in range(1, max_new_tokens):
+            if (done is not None and i % check_every == 0
+                    and bool(jax.device_get(done).all())):
+                break
+            if greedy:
+                tok, cache = step(self.params, cache, tok[:, None])
+            else:
+                tok, cache = step(self.params, cache, tok[:, None], base, i)
             out.append(tok)
+            if done is not None:
+                done = done | (tok == eos_token)
         return np.asarray(jax.device_get(jnp.stack(out, axis=1)))
 
     def generate_batch(self, prompts: List[np.ndarray],
-                       max_new_tokens: int) -> List[np.ndarray]:
+                       max_new_tokens: int, **gen_kwargs) -> List[np.ndarray]:
         """Batcher adapter: list of same-length 1-D prompts -> list of
         generated 1-D token arrays.  Groups by prompt length defensively
         (the batcher's bucket_fn normally guarantees uniformity) and pads
-        the batch dim to the engine's bucketed shapes."""
+        the batch dim to the engine's bucketed shapes.  ``gen_kwargs``
+        forward to ``generate`` (eos/sampling); with ``eos_token`` each
+        row is trimmed just past its own first eos."""
+        eos_token = gen_kwargs.get("eos_token")
         by_len: Dict[int, List[int]] = {}
         for i, p in enumerate(prompts):
             by_len.setdefault(len(p), []).append(i)
@@ -208,9 +428,9 @@ class ServeEngine:
         for _, idxs in by_len.items():
             stacked = np.stack([prompts[i] for i in idxs]).astype(np.int32)
             padded = pad_rows(stacked, self.bucket_rows(len(idxs)))
-            gen = self.generate(padded, max_new_tokens)
+            gen = self.generate(padded, max_new_tokens, **gen_kwargs)
             for row, i in enumerate(idxs):
-                results[i] = gen[row]
+                results[i] = _trim_at_eos(gen[row], eos_token)
         return results  # type: ignore[return-value]
 
     # -- classify (mnist / resnet50 / bert) ----------------------------------

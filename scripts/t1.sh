@@ -5,8 +5,10 @@
 #
 # Usage: bash scripts/t1.sh   (from the repo root)
 #
-# '-m not slow' keeps the subprocess smokes (test_bench_smoke.py,
-# test_serve_smoke.py — cold-jit entrypoint runs) out of the gate; run
-# them explicitly with: python -m pytest tests/ -q -m slow
+# '-m not slow and not serve_slow' keeps the subprocess smokes
+# (test_bench_smoke.py, test_serve_smoke.py — cold-jit entrypoint runs,
+# the continuous-batching ones additionally marked serve_slow) out of the
+# gate; run them explicitly with:
+#   python -m pytest tests/ -q -m 'slow or serve_slow'
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow and not serve_slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

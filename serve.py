@@ -10,6 +10,8 @@ Examples:
     python serve.py --model=gpt2 --checkpoint_dir=/tmp/ckpt --max_batch_size=8
     python serve.py --model=mnist --steps=64                 # classify path
     python serve.py --model=gpt2 --tensor=2                  # TP decode
+    python serve.py --model=gpt2 --continuous --num_slots=8 \
+        --prompt_lens=8,16,24 --min_new_tokens=4             # continuous batching
 """
 
 import argparse
@@ -45,9 +47,31 @@ def parse_args(argv=None):
                         "bound are rejected with backpressure")
     p.add_argument("--max_new_tokens", type=int,
                    default=defaults.max_new_tokens)
+    p.add_argument("--min_new_tokens", type=int,
+                   default=defaults.min_new_tokens,
+                   help="when >0 and < max_new_tokens, per-request decode "
+                        "horizons cycle between min and max (mixed traffic)")
     p.add_argument("--prompt_len", type=int, default=defaults.prompt_len)
+    p.add_argument("--prompt_lens", default=defaults.prompt_lens,
+                   help="comma-separated prompt lengths to cycle, e.g. "
+                        "'8,16,24' (mixed traffic); empty = uniform "
+                        "--prompt_len")
     p.add_argument("--clients", type=int, default=defaults.clients,
                    help="concurrent synthetic client threads")
+    p.add_argument("--continuous", action="store_true",
+                   default=defaults.continuous,
+                   help="iteration-level decode scheduling over one "
+                        "resident KV cache (serve/continuous.py) instead "
+                        "of fixed request-level batches")
+    p.add_argument("--num_slots", type=int, default=defaults.num_slots,
+                   help="continuous mode: decode slots in the resident KV "
+                        "cache (rounded up to the data-parallel row "
+                        "multiple)")
+    p.add_argument("--temperature", type=float, default=defaults.temperature,
+                   help="sampling temperature; 0 = greedy argmax (default)")
+    p.add_argument("--top_k", type=int, default=defaults.top_k,
+                   help="restrict sampling to the k highest logits "
+                        "(0 = full vocab); only with --temperature > 0")
     p.add_argument("--preset", default=None,
                    help="gpt2 config preset (tiny|small|medium); default "
                         "tiny on CPU, medium on TPU")

@@ -448,6 +448,66 @@ class TestServeEngine:
 
 
 # ---------------------------------------------------------------------------
+# EOS early exit in the fixed-batch path
+# ---------------------------------------------------------------------------
+
+class TestEosEarlyExit:
+    def _reference(self, eng, prompts, n):
+        """Greedy stream with no eos — the early-exit runs must be a
+        prefix of this (same jitted program, deterministic on CPU)."""
+        return eng.generate(prompts, n)
+
+    def test_stops_before_horizon_when_all_rows_hit_eos(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        prompt = np.asarray(jax.random.randint(
+            jax.random.key(8), (1, 6), 0, vocab))
+        prompts = np.repeat(prompt, 8, axis=0)  # identical rows: one eos hit
+        ref = self._reference(gpt2_engine, prompts, 12)
+        eos = int(ref[0, 3])
+        out = gpt2_engine.generate(prompts, 12, eos_token=eos,
+                                   eos_check_every=1)
+        assert out.shape[1] == 4  # stopped at the eos, not the horizon
+        np.testing.assert_array_equal(out, ref[:, :4])
+
+    def test_check_cadence_bounds_overshoot(self, gpt2_engine):
+        """With eos_check_every=N the loop may overshoot by < N steps but
+        still stops well short of the horizon; emitted tokens stay a prefix
+        of the unrestricted stream."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        prompt = np.asarray(jax.random.randint(
+            jax.random.key(8), (1, 6), 0, vocab))
+        prompts = np.repeat(prompt, 8, axis=0)
+        ref = self._reference(gpt2_engine, prompts, 16)
+        eos = int(ref[0, 3])
+        out = gpt2_engine.generate(prompts, 16, eos_token=eos,
+                                   eos_check_every=4)
+        assert 4 <= out.shape[1] < 4 + 4  # eos at 4, next check within 4
+        np.testing.assert_array_equal(out, ref[:, : out.shape[1]])
+
+    def test_no_eos_decodes_full_horizon(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        prompts = np.asarray(jax.random.randint(
+            jax.random.key(9), (8, 5), 0, vocab))
+        ref = self._reference(gpt2_engine, prompts, 6)
+        out = gpt2_engine.generate(prompts, 6, eos_token=vocab - 1
+                                   if (ref != vocab - 1).all() else None,
+                                   eos_check_every=1)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_generate_batch_trims_each_row_at_its_eos(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, vocab, size=(5,), dtype=np.int32)
+                   for _ in range(3)]
+        ref = gpt2_engine.generate_batch(prompts, 8)
+        eos = int(ref[1][2])  # row 1 should cut at index 2 (inclusive)
+        outs = gpt2_engine.generate_batch(prompts, 8, eos_token=eos)
+        assert len(outs[1]) <= 3 and outs[1][-1] == eos
+        for r, o in zip(ref, outs):
+            np.testing.assert_array_equal(o, r[: len(o)])
+
+
+# ---------------------------------------------------------------------------
 # CheckpointManager teardown surface (satellite b)
 # ---------------------------------------------------------------------------
 

@@ -2,7 +2,10 @@
 JSON line from ``serve.py`` and from ``bench.py --mode=serve``.
 
 Marked ``slow`` (excluded from tier-1, like test_bench_smoke.py) — each
-subprocess compiles the tiny GPT-2 prefill + decode programs cold.
+subprocess compiles the tiny GPT-2 prefill + decode programs cold.  The
+continuous-batching entrypoint smokes additionally carry ``serve_slow``
+(they compile one slot-prefill program per distinct prompt length on top
+of the decode step), so either marker alone keeps them out of tier-1.
 """
 
 import json
@@ -48,12 +51,34 @@ def test_serve_entrypoint_prints_one_json_line():
 
 
 @pytest.mark.slow
+@pytest.mark.serve_slow
+def test_serve_entrypoint_continuous_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--num_slots=8", "--steps=16",
+                "--prompt_lens=6,8", "--max_new_tokens=6",
+                "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    for key in ("tokens_per_sec", "slot_occupancy", "iterations",
+                "admissions_per_iter", "retirements_per_iter",
+                "ttft_p50_ms", "ttft_p99_ms", "tpot_mean_ms",
+                "p50_latency_ms", "p99_latency_ms"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["completed"] == 16
+    assert 0.0 < out["slot_occupancy"] <= 1.0
+    assert out["ttft_p99_ms"] >= out["ttft_p50_ms"]
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
     for key in ("metric", "value", "unit", "vs_baseline",
-                "p50_latency_ms", "p99_latency_ms"):
+                "p50_latency_ms", "p99_latency_ms",
+                "ttft_p50_ms", "tpot_mean_ms", "slot_occupancy",
+                "fixed_tokens_per_sec", "continuous_speedup"):
         assert key in out, f"missing {key!r} in {out}"
     assert out["unit"] == "tokens/sec"
     assert out["value"] > 0
+    assert out["fixed_tokens_per_sec"] > 0
     assert "serve_tokens_per_sec" in out["metric"]

@@ -105,14 +105,92 @@ class GPT2Config:
                    n_head=4, dropout=0.0, **kw)
 
 
+@dataclasses.dataclass(frozen=True)
+class PagedKVConfig:
+    """Geometry of the block-table (paged) KV cache — vLLM-style
+    (Kwon et al., SOSP 2023; PAPERS.md).
+
+    Instead of one dense ``(num_slots, max_total_len)`` K/V row per slot,
+    K/V live in a ``(num_blocks, block_size, heads, head_dim)`` pool per
+    layer and each slot maps its logical positions to physical blocks
+    through a host-managed ``(num_slots, max_blocks_per_slot)`` int32 block
+    table passed into every decode call.  A request only pins the blocks
+    its current length actually covers, so a 30-token request no longer
+    reserves a full worst-case row.
+
+    Physical block 0 is the TRASH block: never allocated to a request,
+    it absorbs the garbage K/V that inactive decode rows write (their
+    table rows are reset to all-zeros at retirement), so a freed-and-
+    reused block can never be corrupted by a stale slot.
+
+    ``kv_dtype`` selects the pool storage dtype: ``None`` stores the
+    model's compute dtype (bit-identical to the dense cache), any dtype
+    name (e.g. ``"bfloat16"``) casts on write, and ``"int8"`` stores
+    symmetric per-token-quantized K/V plus f32 scale tables of shape
+    ``(num_blocks, block_size)`` (one scale per written token position,
+    shared across heads) that dequantize in the attention gather.
+
+    Frozen + hashable on purpose: the engine keys its jitted program cache
+    by this config, and the model treats every field as compile-time
+    static.
+    """
+
+    block_size: int = 16
+    num_blocks: int = 64
+    kv_dtype: Optional[str] = None  # None | "int8" | a jnp dtype name
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved trash "
+                f"block), got {self.num_blocks}")
+        if self.kv_dtype is not None:
+            jnp.dtype(self.kv_dtype)  # fail fast on typos
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    def storage_dtype(self, compute_dtype):
+        if self.kv_dtype is None:
+            return compute_dtype
+        return jnp.dtype(self.kv_dtype)
+
+    def blocks_for(self, tokens: int) -> int:
+        """Physical blocks covering ``tokens`` logical positions."""
+        return -(-max(0, tokens) // self.block_size)
+
+    def max_blocks_per_slot(self, total_len: int) -> int:
+        return self.blocks_for(total_len)
+
+    @property
+    def usable_blocks(self) -> int:
+        """Blocks available to requests (pool minus the trash block)."""
+        return self.num_blocks - 1
+
+
+def _quantize_kv_int8(x):
+    """Symmetric per-token int8: one f32 scale per (row, position), shared
+    across heads — write-local, so appending a token never rescales data
+    already in the block."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=(-2, -1)) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
 class Block(nn.Module):
     cfg: GPT2Config
     mesh: Optional[Mesh] = None
     deterministic: bool = True  # attribute (not call arg) so nn.scan can map
     decode: bool = False  # KV-cache incremental decode (serve path)
+    paged: Optional[PagedKVConfig] = None  # block-table cache (serve path)
 
     @nn.compact
-    def __call__(self, x, slot_ids=None):
+    def __call__(self, x, slot_ids=None, block_tables=None):
         cfg = self.cfg
         deterministic = self.deterministic
         d, h = cfg.d_model, cfg.n_head
@@ -125,7 +203,12 @@ class Block(nn.Module):
         q = q.reshape(B, T, h, head_dim)
         k = k.reshape(B, T, h, head_dim)
         v = v.reshape(B, T, h, head_dim)
-        if self.decode:
+        if self.decode and self.paged is not None:
+            # Paged serve path: K/V in a fixed pool of blocks, each slot's
+            # logical positions routed through its block-table row.
+            ctx = self._paged_cached_attention(
+                q, k, v, slot_ids, block_tables).reshape(B, T, d)
+        elif self.decode:
             # Serve path: exact attention over the preallocated KV cache.
             # Takes precedence over ring/flash — both are training-shape
             # kernels; decode works on (B, 1, ...) steps against the cache.
@@ -240,6 +323,95 @@ class Block(nn.Module):
         probs = probs.astype(cfg.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 
+    def _paged_cached_attention(self, q, k, v, slot_ids, block_tables):
+        """Exact attention over the block-table KV pool.
+
+        K/V storage is a ``(num_blocks, block_size, H, hd)`` pool; logical
+        position ``p`` of slot ``s`` lives at physical block
+        ``block_tables[s, p // block_size]``, offset ``p % block_size``.
+        Each call scatters its new K/V into the owning blocks (one write
+        per (row, token) — offsets are unique within a call because slot
+        ids are), then gathers the slot's whole table row back into a
+        contiguous ``(B, max_blocks * block_size, H, hd)`` view for the
+        same masked softmax as the dense slot path.  Unallocated table
+        entries point at trash block 0, whose (finite garbage) contents
+        sit past each row's ``cache_index`` and are causally masked.
+
+        With ``kv_dtype="int8"`` the pool stores per-token symmetrically
+        quantized values plus ``(num_blocks, block_size)`` f32 scale
+        tables, dequantized here in the gather; any other ``kv_dtype``
+        is a plain cast on write.  When the storage dtype equals the
+        compute dtype and ``max_blocks * block_size == max_total_len``,
+        the post-gather math is shape-identical to the dense slot path —
+        greedy streams match it token for token.
+        """
+        cfg, pg = self.cfg, self.paged
+        B, T, h, head_dim = q.shape
+        bs = pg.block_size
+        store_dtype = pg.storage_dtype(cfg.dtype)
+        kp = self.variable(
+            "cache", "cached_key_pool",
+            lambda: jnp.zeros((pg.num_blocks, bs, h, head_dim), store_dtype))
+        vp = self.variable(
+            "cache", "cached_value_pool",
+            lambda: jnp.zeros((pg.num_blocks, bs, h, head_dim), store_dtype))
+        if pg.quantized:
+            ksc = self.variable(
+                "cache", "key_scale",
+                lambda: jnp.zeros((pg.num_blocks, bs), jnp.float32))
+            vsc = self.variable(
+                "cache", "value_scale",
+                lambda: jnp.zeros((pg.num_blocks, bs), jnp.float32))
+        ci = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((B,), jnp.int32))
+
+        idx = ci.value[slot_ids]                              # (B,)
+        rows_bt = jnp.maximum(block_tables, 0)[slot_ids]      # (B, max_blk)
+        pos = idx[:, None] + jnp.arange(T)[None, :]           # (B, T)
+        pb = jnp.take_along_axis(rows_bt, pos // bs, axis=1)  # (B, T)
+        off = pos % bs
+        flat_pb, flat_off = pb.reshape(-1), off.reshape(-1)
+        if pg.quantized:
+            kq, k_scale = _quantize_kv_int8(k)
+            vq, v_scale = _quantize_kv_int8(v)
+            kp.value = kp.value.at[flat_pb, flat_off].set(
+                kq.reshape(B * T, h, head_dim))
+            vp.value = vp.value.at[flat_pb, flat_off].set(
+                vq.reshape(B * T, h, head_dim))
+            ksc.value = ksc.value.at[flat_pb, flat_off].set(
+                k_scale.reshape(-1))
+            vsc.value = vsc.value.at[flat_pb, flat_off].set(
+                v_scale.reshape(-1))
+        else:
+            kp.value = kp.value.at[flat_pb, flat_off].set(
+                k.astype(store_dtype).reshape(B * T, h, head_dim))
+            vp.value = vp.value.at[flat_pb, flat_off].set(
+                v.astype(store_dtype).reshape(B * T, h, head_dim))
+        ci.value = ci.value.at[slot_ids].set(idx + T)
+
+        gk = kp.value[rows_bt]                # (B, max_blk, bs, H, hd)
+        gv = vp.value[rows_bt]
+        if pg.quantized:
+            gk = (gk.astype(jnp.float32)
+                  * ksc.value[rows_bt][..., None, None]).astype(cfg.dtype)
+            gv = (gv.astype(jnp.float32)
+                  * vsc.value[rows_bt][..., None, None]).astype(cfg.dtype)
+        else:
+            gk = gk.astype(cfg.dtype)
+            gv = gv.astype(cfg.dtype)
+        S = rows_bt.shape[1] * bs
+        gk = gk.reshape(B, S, h, head_dim)
+        gv = gv.reshape(B, S, h, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, gk) / np.sqrt(head_dim)
+        q_pos = idx[:, None] + jnp.arange(T)[None, :]         # (B, T)
+        mask = jnp.arange(S)[None, None, :] <= q_pos[:, :, None]
+        scores = jnp.where(
+            mask[:, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, gv)
+
 
 class GPT2(nn.Module):
     cfg: GPT2Config
@@ -248,11 +420,24 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, deterministic: bool = True,
                  return_hidden: bool = False, decode: bool = False,
-                 slot_ids=None):
+                 slot_ids=None, paged: Optional[PagedKVConfig] = None,
+                 block_tables=None):
         cfg = self.cfg
         B, T = tokens.shape
         if slot_ids is not None and not decode:
             raise ValueError("slot_ids only applies to decode=True calls")
+        if paged is not None:
+            if slot_ids is None:
+                raise ValueError(
+                    "paged KV cache requires slot_ids (the block table is "
+                    "indexed per slot; only the continuous-batching slot "
+                    "path is paged)")
+            if block_tables is None:
+                raise ValueError(
+                    "paged=... requires block_tables, the (num_slots, "
+                    "max_blocks_per_slot) int32 logical->physical block map")
+        elif block_tables is not None:
+            raise ValueError("block_tables only applies with paged=...")
         wte = self.param(
             "wte",
             nn.initializers.normal(0.02),
@@ -318,20 +503,20 @@ class GPT2(nn.Module):
                 body,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=nn.broadcast,  # slot_ids is shared by every layer
+                in_axes=nn.broadcast,  # slot_ids/tables shared by every layer
                 length=cfg.n_layer,
                 unroll=cfg.scan_unroll,
             )
             x, _ = Scanned(
                 cfg, mesh=self.mesh, deterministic=deterministic,
-                decode=decode, name="blocks",
-            )(x, slot_ids)
+                decode=decode, paged=paged, name="blocks",
+            )(x, slot_ids, block_tables)
         else:
             for i in range(cfg.n_layer):
                 x, _ = Block(
                     cfg, mesh=self.mesh, deterministic=deterministic,
-                    decode=decode, name=f"h_{i}",
-                )(x, slot_ids)
+                    decode=decode, paged=paged, name=f"h_{i}",
+                )(x, slot_ids, block_tables)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             # Chunked-CE path: the loss computes logits per T-chunk itself
@@ -611,6 +796,15 @@ def gpt2_cache_rules() -> ShardingRules:
     """
     return ShardingRules(
         [
+            # Paged pools (L, num_blocks, block_size, H, hd): the block dim
+            # is NOT a batch dim — any slot's tokens can live in any block —
+            # so only heads shard (over ``tensor``, same layout the qkv
+            # projection writes); data-sharded per-shard pools are the
+            # multi-host-serve item (ROADMAP).  Scale tables replicate.
+            (r"blocks/cached_(key|value)_pool",
+             P(None, None, None, "tensor", None)),
+            (r"cached_(key|value)_pool", P(None, None, "tensor", None)),
+            (r"(key|value)_scale", P()),
             (r"blocks/cached_(key|value)",
              P(None, ("data", "fsdp"), None, "tensor")),
             (r"cached_(key|value)", P(("data", "fsdp"), None, "tensor")),

@@ -70,6 +70,24 @@ class ServeMonitorHook(Hook):
                 s.get("tpot_mean_ms", 0.0),
                 s.get("p50_latency_ms", 0.0), s.get("p99_latency_ms", 0.0),
             )
+            if "blocks_total" in s:
+                # Block-pool gauges: a dense cache reports trivially full
+                # (util=1.00, every slot pinning a whole row) so the same
+                # dashboard shows what switching to paged reclaims.
+                logger.info(
+                    "serve @ %d: kv blocks=%d/%d util=%.2f hw=%d "
+                    "blk/req p50=%.0f mean=%.1f max=%.0f "
+                    "(block_size=%d, kv=%.1fMiB)",
+                    step, int(s.get("blocks_in_use", 0)),
+                    int(s.get("blocks_total", 0)),
+                    s.get("block_utilization", 0.0),
+                    int(s.get("blocks_high_water", 0)),
+                    s.get("blocks_per_request_p50", 0.0),
+                    s.get("blocks_per_request_mean", 0.0),
+                    s.get("blocks_per_request_max", 0.0),
+                    int(s.get("block_size", 0)),
+                    s.get("kv_hbm_bytes", 0.0) / 2**20,
+                )
         else:
             logger.info(
                 "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "

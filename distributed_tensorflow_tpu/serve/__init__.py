@@ -12,6 +12,10 @@ Layers:
 - ``continuous``: Orca-style iteration-level decode scheduling over ONE
   resident KV cache (``ContinuousScheduler``) — admit into free slots,
   one (num_slots, 1) step per iteration, retire mid-flight;
+- ``paged``: host-side block bookkeeping for ``cache_mode="paged"``
+  (``BlockAllocator``) — K/V lives in a fixed pool of blocks reached
+  through per-slot block tables, with optional int8 storage
+  (``models.gpt2.PagedKVConfig``);
 - ``driver``: the in-process request loop behind ``serve.py`` and
   ``bench.py --mode=serve`` (``run_serve`` / ``ServeArgs``);
 - ``obs.ServeMonitorHook`` exports the batcher's/scheduler's counters
@@ -25,8 +29,14 @@ from distributed_tensorflow_tpu.serve.batcher import (
 from distributed_tensorflow_tpu.serve.continuous import ContinuousScheduler
 from distributed_tensorflow_tpu.serve.driver import ServeArgs, run_serve
 from distributed_tensorflow_tpu.serve.engine import ServeEngine, pad_rows
+from distributed_tensorflow_tpu.serve.paged import (
+    BlockAllocator,
+    BlockExhaustedError,
+)
 
 __all__ = [
+    "BlockAllocator",
+    "BlockExhaustedError",
     "ContinuousScheduler",
     "DynamicBatcher",
     "ServeArgs",

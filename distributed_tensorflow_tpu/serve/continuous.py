@@ -46,6 +46,7 @@ from distributed_tensorflow_tpu.serve.batcher import (
     ServeOverloadedError,
     _percentile,
 )
+from distributed_tensorflow_tpu.serve.paged import BlockAllocator
 
 logger = logging.getLogger(__name__)
 
@@ -63,12 +64,22 @@ class _SlotRequest:
     finished_at: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: int = -1
+    # Paged mode: worst-case blocks admission reserved for this request
+    # that have NOT been physically allocated yet (released as the slot's
+    # length crosses block boundaries, or at retirement).
+    reserved_blocks: int = 0
 
     def done(self) -> bool:
         if len(self.tokens) >= self.max_new_tokens:
             return True
         return (self.eos_token is not None and len(self.tokens) > 0
                 and self.tokens[-1] == self.eos_token)
+
+    def max_written_tokens(self) -> int:
+        """Most K/V positions this request can ever write: the prompt plus
+        one per decode step (the last generated token never re-enters the
+        cache)."""
+        return len(self.prompt) + self.max_new_tokens - 1
 
 
 class ContinuousScheduler:
@@ -96,6 +107,10 @@ class ContinuousScheduler:
         eos_token: Optional[int] = None,
         temperature: float = 0.0,
         top_k: int = 0,
+        cache_mode: str = "dense",
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -104,6 +119,13 @@ class ContinuousScheduler:
             raise ValueError(
                 "ContinuousScheduler serves the KV-cache decode path; "
                 f"model {engine.model!r} has no decode cache")
+        if cache_mode not in ("dense", "paged"):
+            raise ValueError(
+                f"cache_mode must be 'dense' or 'paged', got {cache_mode!r}")
+        if cache_mode == "dense" and kv_dtype is not None:
+            raise ValueError(
+                "kv_dtype applies to cache_mode='paged' only (the dense "
+                "cache stores the model's compute dtype)")
         self.engine = engine
         self.num_slots = engine.bucket_rows(max(1, num_slots))
         self.max_total_len = int(max_total_len or cfg.n_positions)
@@ -111,8 +133,43 @@ class ContinuousScheduler:
         self.eos_token = eos_token
         self.temperature = float(temperature)
         self.top_k = int(top_k)
-        self._cache = engine.init_slot_cache(
-            self.num_slots, self.max_total_len)
+        self.cache_mode = cache_mode
+        self.block_size = int(block_size)
+        if cache_mode == "paged":
+            from distributed_tensorflow_tpu.models.gpt2 import PagedKVConfig
+
+            per_slot = -(-self.max_total_len // self.block_size)
+            if num_blocks is None:
+                # Safe default: full capacity (every slot at max length)
+                # plus the trash block — no savings until sized down, but
+                # never any block-wait either.
+                num_blocks = self.num_slots * per_slot + 1
+            self.paged: Optional["PagedKVConfig"] = PagedKVConfig(
+                block_size=self.block_size, num_blocks=int(num_blocks),
+                kv_dtype=kv_dtype)
+            self._cache = engine.init_paged_cache(
+                self.num_slots, self.max_total_len, paged=self.paged)
+            self._allocator: Optional[BlockAllocator] = BlockAllocator(
+                self.paged.num_blocks, self.block_size)
+            # Host-owned logical->physical map, one row per slot; all-zero
+            # rows (and entries past a slot's allocation) point at trash
+            # block 0.  Passed into every prefill/decode call.
+            self._block_tables = np.zeros(
+                (self.num_slots, per_slot), np.int32)
+            self._slot_blocks: Dict[int, List[int]] = {
+                s: [] for s in range(self.num_slots)}
+        else:
+            self.paged = None
+            self._allocator = None
+            self._block_tables = None
+            self._slot_blocks = {}
+            self._cache = engine.init_slot_cache(
+                self.num_slots, self.max_total_len)
+        self.kv_hbm_bytes = int(engine.cache_hbm_bytes(self._cache))
+        self._reserved = 0  # paged: reserved-but-unallocated blocks
+        self._blocks_per_request: collections.deque = collections.deque(
+            maxlen=1024)
+        self._blocks_hist: collections.Counter = collections.Counter()
         self._free: List[int] = list(range(self.num_slots))
         self._active: Dict[int, _SlotRequest] = {}
         self._last_tok = np.zeros((self.num_slots, 1), np.int32)
@@ -147,16 +204,35 @@ class ContinuousScheduler:
         """Enqueue one prompt; Future resolves to its 1-D token array the
         moment ITS slot retires (out of submission order by design).
 
+        Rejection happens HERE, not mid-decode: a request that can never
+        fit its slot (``prompt_len + max_new_tokens > max_total_len``, an
+        empty prompt, or — paged mode — a worst-case block footprint the
+        whole pool cannot hold) fails with ``ValueError`` at submit time
+        instead of being admitted and dying halfway through its stream.
+
         Raises ``ServeOverloadedError`` when the admission queue is at
         ``max_queue_size`` and ``RuntimeError`` after ``close()``.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) + max_new_tokens > self.max_total_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_total_len {self.max_total_len}")
+                f"exceeds max_total_len {self.max_total_len}; the request "
+                f"would be admitted and then fail mid-decode — rejected at "
+                f"submit instead")
+        if self.paged is not None:
+            need = self.paged.blocks_for(len(prompt) + max_new_tokens - 1)
+            if need > self._allocator.capacity:
+                raise ValueError(
+                    f"request needs up to {need} KV blocks (prompt "
+                    f"{len(prompt)} + max_new_tokens {max_new_tokens}, "
+                    f"block_size {self.block_size}) but the pool only has "
+                    f"{self._allocator.capacity} usable blocks — it could "
+                    f"never be admitted")
         req = _SlotRequest(
             prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token=self.eos_token if eos_token is None else eos_token,
@@ -186,16 +262,54 @@ class ContinuousScheduler:
             return self.submit(payload[0], max_new_tokens=int(payload[1]))
         return self.submit(payload)
 
+    @property
+    def paged_equivalent_blocks(self) -> int:
+        """Blocks a dense slot pins for its whole lifetime: the full
+        ``max_total_len`` row, expressed in ``block_size`` units so dense
+        and paged block gauges are directly comparable."""
+        return -(-self.max_total_len // self.block_size)
+
+    def _block_stats(self) -> Dict[str, float]:
+        """Block-pool gauges (call under ``_lock``).  Dense mode reports
+        its trivially-full equivalent — every slot permanently pins a full
+        row — so dashboards show exactly what paging reclaims."""
+        if self._allocator is not None:
+            out = self._allocator.stats()
+        else:
+            total = float(self.num_slots * self.paged_equivalent_blocks)
+            out = {
+                "blocks_total": total,
+                "blocks_free": 0.0,
+                "blocks_in_use": total,
+                "block_utilization": 1.0,
+                "blocks_high_water": total,
+            }
+        per_req = sorted(self._blocks_per_request)
+        out["blocks_per_request_mean"] = (
+            sum(per_req) / len(per_req) if per_req else 0.0)
+        out["blocks_per_request_p50"] = _percentile(per_req, 0.50)
+        out["blocks_per_request_max"] = float(per_req[-1]) if per_req else 0.0
+        out["block_size"] = float(self.block_size)
+        out["kv_hbm_bytes"] = float(self.kv_hbm_bytes)
+        return out
+
+    def blocks_per_request_hist(self) -> Dict[int, int]:
+        """Histogram of blocks pinned per retired request (all-time)."""
+        with self._lock:
+            return dict(self._blocks_hist)
+
     def stats(self) -> Dict[str, float]:
         """Counter snapshot (ServeMonitorHook export surface).  Includes
         the iteration-level counters: slot occupancy, admissions /
-        retirements per iteration, TTFT / TPOT percentiles."""
+        retirements per iteration, TTFT / TPOT percentiles, and the
+        block-pool gauges (trivially full in dense mode)."""
         with self._lock:
             lat = sorted(self._latencies_ms)
             ttft = sorted(self._ttft_ms)
             tpot = self._tpot_ms
             iters = self._iterations
             return {
+                **self._block_stats(),
                 "queue_depth": float(len(self._queue)),
                 "capacity": float(self.max_queue_size),
                 "submitted": float(self._submitted),
@@ -262,9 +376,18 @@ class ContinuousScheduler:
                         self._cond.wait()
                     if self._stopped:
                         return
-                    while self._queue and self._free:
+                    while (self._queue and self._free
+                           and self._can_admit(self._queue[0])):
                         req = self._queue.popleft()
                         req.slot = self._free.pop()
+                        if self.paged is not None:
+                            # Reserve the worst-case block count now so a
+                            # mid-decode boundary cross can always be
+                            # served — admission is what waits on blocks,
+                            # never a half-decoded stream.
+                            req.reserved_blocks = self.paged.blocks_for(
+                                req.max_written_tokens())
+                            self._reserved += req.reserved_blocks
                         admits.append(req)
                 self._admit(admits)
                 self._decode_once()
@@ -280,6 +403,40 @@ class ContinuousScheduler:
                 if not req.future.done():
                     req.future.set_exception(e)
 
+    def _can_admit(self, req: _SlotRequest) -> bool:
+        """Paged admission also waits on blocks: the pool must cover the
+        request's worst-case footprint BEYOND what is already promised to
+        in-flight requests (their unallocated reservations).  Head-of-line
+        only — no skipping, so admission order stays FIFO."""
+        if self.paged is None:
+            return True
+        need = self.paged.blocks_for(req.max_written_tokens())
+        return self._allocator.free_count - self._reserved >= need
+
+    def _ensure_blocks(self, req: _SlotRequest, tokens_written: int) -> None:
+        """Allocate-on-boundary-cross: grow the slot's block list (and its
+        block-table row) to cover ``tokens_written`` positions, consuming
+        the request's admission reservation.  Reservations make this
+        infallible for admitted requests."""
+        if self.paged is None:
+            return
+        blocks = self._slot_blocks[req.slot]
+        needed = self.paged.blocks_for(tokens_written)
+        if needed <= len(blocks):
+            return
+        fresh = self._allocator.allocate(needed - len(blocks), slot=req.slot)
+        self._block_tables[req.slot, len(blocks):needed] = fresh
+        blocks.extend(fresh)
+        with self._lock:
+            release = min(req.reserved_blocks, len(fresh))
+            req.reserved_blocks -= release
+            self._reserved -= release
+
+    def _paged_call_kwargs(self) -> Dict[str, Any]:
+        if self.paged is None:
+            return {}
+        return {"paged": self.paged, "block_tables": self._block_tables}
+
     def _admit(self, admits: List[_SlotRequest]) -> None:
         """Slot-local prefill per admitted request.  Prompts are prefilled
         one request at a time — each (1, T_prompt) program compiles once
@@ -287,10 +444,11 @@ class ContinuousScheduler:
         slot's rows of the resident cache."""
         now = time.monotonic()
         for req in admits:
+            self._ensure_blocks(req, len(req.prompt))
             tok_dev, self._cache = self.engine.prefill_into_slots(
                 self._cache, req.prompt[None, :], [req.slot],
                 temperature=self.temperature, top_k=self.top_k,
-                counter=self._next_counter())
+                counter=self._next_counter(), **self._paged_call_kwargs())
             tok = int(np.asarray(jax.device_get(tok_dev))[0])
             req.first_token_at = time.monotonic()
             req.tokens.append(tok)
@@ -314,10 +472,15 @@ class ContinuousScheduler:
             return
         active = np.zeros((self.num_slots,), bool)
         active[active_slots] = True
+        for slot in active_slots:
+            # The upcoming step writes each slot's position
+            # prompt + len(tokens) - 1; cross a block boundary -> allocate.
+            req = self._active[slot]
+            self._ensure_blocks(req, len(req.prompt) + len(req.tokens))
         tok_dev, self._cache = self.engine.decode_slots(
             self._cache, self._last_tok, active,
             temperature=self.temperature, top_k=self.top_k,
-            counter=self._next_counter())
+            counter=self._next_counter(), **self._paged_call_kwargs())
         toks = np.asarray(jax.device_get(tok_dev))
         with self._lock:
             self._iterations += 1
@@ -338,7 +501,25 @@ class ContinuousScheduler:
 
     def _retire(self, req: _SlotRequest) -> None:
         req.finished_at = time.monotonic()
+        if self.paged is not None:
+            # Bulk-free the slot's blocks and point its table row back at
+            # trash block 0 BEFORE the slot can go inactive — the shared
+            # decode step's garbage writes for idle rows must never land
+            # in a reallocated block.
+            blocks = self._slot_blocks[req.slot]
+            used = len(blocks)
+            if blocks:
+                self._allocator.free(blocks)
+                self._slot_blocks[req.slot] = []
+            self._block_tables[req.slot, :] = 0
+        else:
+            used = self.paged_equivalent_blocks
         with self._lock:
+            if self.paged is not None:
+                self._reserved -= req.reserved_blocks
+                req.reserved_blocks = 0
+            self._blocks_per_request.append(used)
+            self._blocks_hist[used] += 1
             self._active.pop(req.slot, None)
             self._free.append(req.slot)
             self._retired += 1

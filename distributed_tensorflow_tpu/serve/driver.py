@@ -73,6 +73,19 @@ class ServeArgs:
     # continuous batching (serve/continuous.py)
     continuous: bool = False
     num_slots: int = 8
+    # KV cache layout for the continuous scheduler: "dense" keeps the
+    # (num_slots, max_total_len) resident cache; "paged" stores K/V in a
+    # block pool indexed through per-slot block tables (serve/paged.py).
+    cache_mode: str = "dense"
+    block_size: int = 16
+    # 0 = auto-size the pool to full capacity (num_slots * blocks-per-slot
+    # + trash block — correctness default, no memory savings); smaller
+    # pools trade admission backpressure for HBM.
+    num_blocks: int = 0
+    # "" = store the model's compute dtype; "int8" = per-token symmetric
+    # quantization with f32 scales; any jnp dtype name ("bfloat16", ...)
+    # stores that dtype directly.
+    kv_dtype: str = ""
     # sampling (greedy argmax when temperature == 0)
     temperature: float = 0.0
     top_k: int = 0
@@ -102,6 +115,18 @@ def _horizons(args: ServeArgs) -> List[int]:
     if lo <= 0 or lo >= hi:
         return [hi]
     return [hi, lo, max(lo, (lo + hi) // 2), hi]
+
+
+def _cache_kwargs(args: ServeArgs) -> Dict[str, Any]:
+    """ContinuousScheduler cache-layout kwargs from the flag surface."""
+    if args.cache_mode == "dense":
+        return {"cache_mode": "dense"}
+    return {
+        "cache_mode": args.cache_mode,
+        "block_size": args.block_size,
+        "num_blocks": args.num_blocks or None,
+        "kv_dtype": args.kv_dtype or None,
+    }
 
 
 def _prompt_lengths(args: ServeArgs) -> List[int]:
@@ -177,6 +202,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             max_queue_size=args.max_queue_size,
             temperature=args.temperature,
             top_k=args.top_k,
+            **_cache_kwargs(args),
         )
         return DynamicBatcher(iteration_level=True, scheduler=scheduler)
 
@@ -211,7 +237,8 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
                               max(p.shape[0] + m for p, m in payloads)),
-            temperature=args.temperature, top_k=args.top_k)
+            temperature=args.temperature, top_k=args.top_k,
+            **_cache_kwargs(args))
         futs = {}
         for length in sorted({p.shape[0] for p, _ in payloads}):
             prompt = next(p for p, _ in payloads if p.shape[0] == length)
@@ -289,6 +316,22 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["ttft_p50_ms"] = round(stats["ttft_p50_ms"], 3)
         out["ttft_p99_ms"] = round(stats["ttft_p99_ms"], 3)
         out["tpot_mean_ms"] = round(stats["tpot_mean_ms"], 4)
+        out["cache_mode"] = args.cache_mode
+        out["kv_dtype"] = args.kv_dtype or None
+        out["kv_hbm_bytes"] = int(stats["kv_hbm_bytes"])
+        out["block_size"] = int(stats["block_size"])
+        out["blocks_total"] = int(stats["blocks_total"])
+        out["blocks_high_water"] = int(stats["blocks_high_water"])
+        out["block_utilization"] = round(stats["block_utilization"], 4)
+        out["blocks_per_request_mean"] = round(
+            stats["blocks_per_request_mean"], 2)
+        logger.info(
+            "serve shutdown: cache_mode=%s%s kv=%.1fMiB blocks hw=%d/%d "
+            "blk/req mean=%.1f",
+            args.cache_mode,
+            f" kv_dtype={args.kv_dtype}" if args.kv_dtype else "",
+            out["kv_hbm_bytes"] / 2**20, out["blocks_high_water"],
+            out["blocks_total"], out["blocks_per_request_mean"])
     else:
         out["avg_batch_occupancy"] = round(
             stats.get("avg_batch_occupancy", 0.0), 3)

@@ -259,6 +259,69 @@ class ServeEngine:
             )
         return self._cache_init_fns[key]()
 
+    def init_paged_cache(self, num_slots: int, total_len: int, *,
+                         paged) -> PyTree:
+        """ONE resident block-table KV cache (``cache_mode="paged"``):
+        per-layer ``(num_blocks, block_size, heads, head_dim)`` K/V pools
+        (plus f32 scale tables under ``kv_dtype="int8"``) and the same
+        per-slot ``(num_slots,)`` index vectors as the dense slot cache.
+        The ``(num_slots, max_blocks_per_slot)`` block table itself is NOT
+        part of this tree — the caller owns it host-side and passes it
+        into every prefill/decode call.
+
+        ``paged`` is a ``models.gpt2.PagedKVConfig``; the pool must hold at
+        least one maximum-length request plus the reserved trash block.
+        """
+        dp = max(1, self.data_parallelism)
+        if num_slots < 1 or num_slots % dp:
+            raise ValueError(
+                f"num_slots {num_slots} must be a positive multiple of the "
+                f"data-parallel extent {dp} (decode rows shard over data)")
+        cfg = getattr(self.module, "cfg", None)
+        if cfg is not None and total_len > cfg.n_positions:
+            raise ValueError(
+                f"max_total_len {total_len} exceeds n_positions "
+                f"{cfg.n_positions}")
+        max_blocks = paged.max_blocks_per_slot(total_len)
+        if paged.usable_blocks < max_blocks:
+            raise ValueError(
+                f"num_blocks {paged.num_blocks} cannot hold one "
+                f"max-length request: need {max_blocks} usable blocks "
+                f"(block_size {paged.block_size} x max_total_len "
+                f"{total_len}) plus the reserved trash block")
+        from distributed_tensorflow_tpu.models.gpt2 import gpt2_cache_rules
+
+        key = ("paged", num_slots, total_len, paged)
+        if key not in self._cache_init_fns:
+            def mk():
+                vs = self.module.init(
+                    jax.random.key(0),
+                    jnp.zeros((num_slots, total_len), jnp.int32),
+                    decode=True,
+                    slot_ids=jnp.arange(num_slots, dtype=jnp.int32),
+                    paged=paged,
+                    block_tables=jnp.zeros((num_slots, max_blocks),
+                                           jnp.int32))
+                return vs["cache"]
+
+            shapes = jax.eval_shape(mk)
+            shardings = gpt2_cache_rules().shardings_for(self.mesh, shapes)
+            self._cache_init_fns[key] = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+                out_shardings=shardings,
+            )
+        return self._cache_init_fns[key]()
+
+    @staticmethod
+    def cache_hbm_bytes(cache: PyTree) -> int:
+        """Resident bytes of a KV cache tree (dense rows or paged pools +
+        scales + index vectors) — the serving-capacity denominator the
+        block-pool gauges and ``bench.py --mode=serve`` report."""
+        return int(sum(
+            int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(cache)))
+
     @staticmethod
     def _reset_slot_rows(cache: PyTree, slot_ids) -> PyTree:
         """Zero ``cache_index``/``position`` rows for ``slot_ids`` — slot
@@ -274,12 +337,18 @@ class ServeEngine:
 
         return jax.tree_util.tree_map_with_path(_one, cache)
 
-    def _prefill_slots_apply(self, temperature, top_k, params, cache,
-                             tokens, slot_ids, rng, counter):
+    @staticmethod
+    def _paged_kwargs(paged, block_tables):
+        return ({} if paged is None
+                else {"paged": paged, "block_tables": block_tables})
+
+    def _prefill_slots_apply(self, temperature, top_k, paged, params, cache,
+                             tokens, slot_ids, block_tables, rng, counter):
         cache = self._reset_slot_rows(cache, slot_ids)
         logits, mutated = self.module.apply(
             {"params": params, "cache": cache}, tokens,
             decode=True, slot_ids=slot_ids, mutable=["cache"],
+            **self._paged_kwargs(paged, block_tables),
         )
         nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
         return nxt, mutated["cache"]
@@ -287,33 +356,44 @@ class ServeEngine:
     def prefill_into_slots(self, cache: PyTree, prompts: np.ndarray,
                            slot_ids: np.ndarray, *,
                            temperature: float = 0.0, top_k: int = 0,
-                           rng=None, counter: int = 0):
+                           rng=None, counter: int = 0,
+                           paged=None, block_tables=None):
         """Admit requests: slot-local prefill writing each prompt's K/V
         into its slot's rows of the RESIDENT cache (state rows reset
         first), returning (first generated tokens (n,), updated cache).
         ``prompts`` is (n, T_prompt) shape-uniform; ``slot_ids`` (n,)
-        unique free slots.  The cache is donated through the call."""
+        unique free slots.  The cache is donated through the call.
+
+        With ``paged`` (a ``PagedKVConfig``) the cache is the block-pool
+        tree from ``init_paged_cache`` and ``block_tables`` the host's
+        (num_slots, max_blocks_per_slot) int32 table, whose rows for
+        ``slot_ids`` must already cover each prompt's blocks."""
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim != 2:
             raise ValueError(f"prompts must be (n, T), got {prompts.shape}")
-        key = ("slot_prefill", float(temperature), int(top_k))
+        if (paged is None) != (block_tables is None):
+            raise ValueError("paged and block_tables go together")
+        key = ("slot_prefill", float(temperature), int(top_k), paged)
         if key not in self._generate_fns:
             self._generate_fns[key] = jax.jit(
                 functools.partial(self._prefill_slots_apply,
-                                  float(temperature), int(top_k)),
+                                  float(temperature), int(top_k), paged),
                 donate_argnums=(1,))
         base = rng if rng is not None else self._sample_rng
+        bt = None if block_tables is None else np.asarray(
+            block_tables, np.int32)
         return self._generate_fns[key](
             self.params, cache, prompts,
-            np.asarray(slot_ids, np.int32), base, counter)
+            np.asarray(slot_ids, np.int32), bt, base, counter)
 
-    def _decode_slots_apply(self, temperature, top_k, params, cache,
-                            tokens, active, rng, counter):
+    def _decode_slots_apply(self, temperature, top_k, paged, params, cache,
+                            tokens, active, block_tables, rng, counter):
         num_slots = tokens.shape[0]
         slots = jnp.arange(num_slots, dtype=jnp.int32)
         logits, mutated = self.module.apply(
             {"params": params, "cache": cache}, tokens,
             decode=True, slot_ids=slots, mutable=["cache"],
+            **self._paged_kwargs(paged, block_tables),
         )
 
         # Active-mask: empty slots are free compute — the step runs over
@@ -335,23 +415,33 @@ class ServeEngine:
 
     def decode_slots(self, cache: PyTree, last_tokens: np.ndarray,
                      active: np.ndarray, *, temperature: float = 0.0,
-                     top_k: int = 0, rng=None, counter: int = 0):
+                     top_k: int = 0, rng=None, counter: int = 0,
+                     paged=None, block_tables=None):
         """One iteration-level decode step over ALL slots: (num_slots, 1)
         tokens against the resident cache, per-slot offsets, inactive
         slots gated by ``active``.  Returns (next tokens (num_slots,),
-        updated cache); the cache is donated through the call."""
-        key = ("slot_decode", float(temperature), int(top_k))
+        updated cache); the cache is donated through the call.
+
+        Paged mode (``paged`` + ``block_tables``): inactive rows still
+        scatter garbage K/V, but their table rows point at trash block 0
+        (the scheduler resets them at retirement), so the garbage never
+        lands in a block owned by a live request."""
+        if (paged is None) != (block_tables is None):
+            raise ValueError("paged and block_tables go together")
+        key = ("slot_decode", float(temperature), int(top_k), paged)
         if key not in self._generate_fns:
             self._generate_fns[key] = jax.jit(
                 functools.partial(self._decode_slots_apply,
-                                  float(temperature), int(top_k)),
+                                  float(temperature), int(top_k), paged),
                 donate_argnums=(1,))
         base = rng if rng is not None else self._sample_rng
         tokens_dev = jax.device_put(
             np.asarray(last_tokens, np.int32), batch_sharding(self.mesh))
+        bt = None if block_tables is None else np.asarray(
+            block_tables, np.int32)
         return self._generate_fns[key](
             self.params, cache, tokens_dev,
-            np.asarray(active, bool), base, counter)
+            np.asarray(active, bool), bt, base, counter)
 
     def generate(self, prompts: np.ndarray, max_new_tokens: int, *,
                  eos_token: Optional[int] = None, eos_check_every: int = 8,

@@ -1,0 +1,113 @@
+"""Host-side block bookkeeping for the paged KV cache.
+
+The device side (``models.gpt2`` paged attention, ``ServeEngine``'s paged
+slot programs) is stateless about placement: every call receives the
+``(num_slots, max_blocks_per_slot)`` block table as an argument.  THIS is
+where placement lives — a plain free-list allocator the
+``ContinuousScheduler`` drives from its scheduling thread:
+
+- allocate-on-admit / on-boundary-cross: a slot asks for blocks lazily as
+  its written length crosses ``block_size`` boundaries, so a request only
+  ever pins the blocks it has actually filled;
+- bulk-free on retire: the slot's whole block list returns to the free
+  list in one call, and its table row resets to the trash block;
+- LIFO reuse: just-freed blocks are handed out first (warm cache lines,
+  and deterministic reuse for the stale-data hygiene tests).
+
+Physical block 0 is reserved as the TRASH block (never allocated):
+inactive decode rows still execute the shared ``(num_slots, 1)`` step and
+scatter garbage K/V somewhere — retired slots' table rows point all
+positions at block 0, so that garbage can never land in a block that has
+been reallocated to a live request.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+TRASH_BLOCK = 0
+
+
+class BlockExhaustedError(RuntimeError):
+    """Raised when an allocation is requested that the pool cannot satisfy.
+
+    Under the scheduler this never fires for admitted requests — admission
+    reserves each request's worst-case block count up front — so seeing it
+    means a bookkeeping bug, not load."""
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` physical KV blocks.
+
+    Block 0 is reserved (trash); ``capacity`` is therefore
+    ``num_blocks - 1``.  Not thread-safe by itself — the scheduler calls it
+    only from its loop thread (or under its lock for stats).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved as trash), "
+                f"got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: low ids at the end so fresh pools allocate 1, 2, …
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owner: Dict[int, int] = {}  # block id -> slot id (debugging)
+        self.high_water = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.capacity - len(self._free)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """Blocks covering ``tokens`` logical positions."""
+        return -(-max(0, int(tokens)) // self.block_size)
+
+    def allocate(self, n: int, *, slot: int = -1) -> List[int]:
+        """Pop ``n`` blocks off the free list; raises
+        ``BlockExhaustedError`` if fewer are free."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise BlockExhaustedError(
+                f"need {n} blocks, only {len(self._free)}/{self.capacity} "
+                f"free")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = slot
+        self.high_water = max(self.high_water, self.used_count)
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        """Return a slot's blocks to the pool (bulk-free on retire)."""
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise ValueError("block 0 (trash) is never allocated/freed")
+            if b in self._owner:
+                del self._owner[b]
+            elif b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+        if len(self._free) > self.capacity:
+            raise AssertionError("freed more blocks than exist")
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "blocks_total": float(self.capacity),
+            "blocks_free": float(self.free_count),
+            "blocks_in_use": float(self.used_count),
+            "block_utilization": (self.used_count / self.capacity
+                                  if self.capacity else 0.0),
+            "blocks_high_water": float(self.high_water),
+        }

@@ -12,6 +12,8 @@ Examples:
     python serve.py --model=gpt2 --tensor=2                  # TP decode
     python serve.py --model=gpt2 --continuous --num_slots=8 \
         --prompt_lens=8,16,24 --min_new_tokens=4             # continuous batching
+    python serve.py --model=gpt2 --continuous --cache_mode=paged \
+        --block_size=16 --kv_dtype=int8                      # paged + int8 KV
 """
 
 import argparse
@@ -67,6 +69,21 @@ def parse_args(argv=None):
                    help="continuous mode: decode slots in the resident KV "
                         "cache (rounded up to the data-parallel row "
                         "multiple)")
+    p.add_argument("--cache_mode", default=defaults.cache_mode,
+                   choices=("dense", "paged"),
+                   help="continuous mode KV layout: 'dense' keeps the "
+                        "(num_slots, max_total_len) cache; 'paged' stores "
+                        "K/V in a block pool through per-slot block tables")
+    p.add_argument("--block_size", type=int, default=defaults.block_size,
+                   help="paged mode: tokens per KV block")
+    p.add_argument("--num_blocks", type=int, default=defaults.num_blocks,
+                   help="paged mode: physical blocks in the pool (0 = full "
+                        "capacity, no savings; smaller pools trade "
+                        "admission backpressure for HBM)")
+    p.add_argument("--kv_dtype", default=defaults.kv_dtype,
+                   help="paged mode: KV storage dtype — '' stores the "
+                        "compute dtype, 'int8' quantizes per token with "
+                        "f32 scales, or any jnp dtype name ('bfloat16')")
     p.add_argument("--temperature", type=float, default=defaults.temperature,
                    help="sampling temperature; 0 = greedy argmax (default)")
     p.add_argument("--top_k", type=int, default=defaults.top_k,

@@ -1,0 +1,393 @@
+"""Paged KV cache tests: the host-side block allocator, the engine's
+paged cache APIs, and the load-bearing property of the whole design —
+greedy decode through block tables is token-for-token identical to the
+dense resident cache, on both acceptance meshes.
+
+Parity is exact array equality (CPU greedy decode is deterministic, and
+with ``kv_dtype=None``/``"bfloat16"`` the pool stores the same bits the
+dense cache would).  ``kv_dtype="int8"`` is lossy by construction, so it
+gets a logits-tolerance check at the model layer plus an end-to-end
+completion check, not bitwise parity.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.gpt2 import GPT2, GPT2Config, PagedKVConfig
+from distributed_tensorflow_tpu.serve import ContinuousScheduler, ServeEngine
+from distributed_tensorflow_tpu.serve.paged import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    BlockExhaustedError,
+)
+
+
+def _mixed_requests(vocab, n=20, seed=1):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        length = (4, 6, 9)[i % 3]
+        horizon = (2, 5, 3, 7)[i % 4]
+        reqs.append((rng.integers(0, vocab, size=(length,), dtype=np.int32),
+                     horizon))
+    return reqs
+
+
+def _fixed_reference(engine, prompt, max_new_tokens):
+    rows = engine.bucket_rows(1)
+    out = engine.generate(np.repeat(prompt[None, :], rows, axis=0),
+                          max_new_tokens)
+    return out[0]
+
+
+@pytest.fixture(scope="module")
+def gpt2_engine(request):
+    mesh_dp = request.getfixturevalue("mesh_dp")
+    eng = ServeEngine("gpt2", mesh=mesh_dp, preset="tiny")
+    yield eng
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator: pure host-side unit tests
+# ---------------------------------------------------------------------------
+
+class TestBlockAllocator:
+    def test_fresh_pool_allocates_low_ids_first(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        assert a.capacity == 7          # block 0 reserved
+        assert a.allocate(3) == [1, 2, 3]
+        assert a.free_count == 4 and a.used_count == 3
+
+    def test_trash_block_never_handed_out(self):
+        a = BlockAllocator(num_blocks=4, block_size=2)
+        assert TRASH_BLOCK not in a.allocate(3)
+
+    def test_exhaustion_raises(self):
+        a = BlockAllocator(num_blocks=4, block_size=2)
+        a.allocate(2)
+        with pytest.raises(BlockExhaustedError, match="only 1/3 free"):
+            a.allocate(2)
+        # the failed call must not have consumed anything
+        assert a.free_count == 1
+
+    def test_free_and_lifo_reuse(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        first = a.allocate(3, slot=5)
+        a.free(first)
+        assert a.free_count == a.capacity
+        # LIFO: the just-freed blocks come back first, in reverse order
+        assert a.allocate(3) == first[::-1]
+
+    def test_double_free_and_trash_free_rejected(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        blocks = a.allocate(2)
+        a.free(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([blocks[0]])
+        with pytest.raises(ValueError, match="trash"):
+            a.free([TRASH_BLOCK])
+
+    def test_stats_and_high_water(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        held = a.allocate(5)
+        a.free(held[2:])
+        s = a.stats()
+        assert s["blocks_total"] == 7.0
+        assert s["blocks_in_use"] == 2.0
+        assert s["blocks_free"] == 5.0
+        assert s["blocks_high_water"] == 5.0  # peak, not current
+        assert s["block_utilization"] == pytest.approx(2 / 7)
+
+    def test_blocks_for_tokens(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        assert [a.blocks_for_tokens(t) for t in (0, 1, 4, 5, 8)] == \
+            [0, 1, 1, 2, 2]
+
+    def test_geometry_validation(self):
+        with pytest.raises(ValueError, match="num_blocks"):
+            BlockAllocator(num_blocks=1, block_size=4)
+        with pytest.raises(ValueError, match="block_size"):
+            BlockAllocator(num_blocks=4, block_size=0)
+
+
+class TestPagedKVConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PagedKVConfig(block_size=0)
+        with pytest.raises(ValueError):
+            PagedKVConfig(num_blocks=1)
+        with pytest.raises(TypeError):
+            PagedKVConfig(kv_dtype="not_a_dtype")
+
+    def test_geometry_helpers(self):
+        cfg = PagedKVConfig(block_size=8, num_blocks=16)
+        assert cfg.usable_blocks == 15
+        assert cfg.blocks_for(17) == 3
+        assert cfg.max_blocks_per_slot(32) == 4
+
+    def test_storage_dtype(self):
+        assert PagedKVConfig().storage_dtype(jnp.bfloat16) == jnp.bfloat16
+        assert (PagedKVConfig(kv_dtype="int8").storage_dtype(jnp.bfloat16)
+                == jnp.int8)
+        assert (PagedKVConfig(kv_dtype="float32").storage_dtype(jnp.bfloat16)
+                == jnp.float32)
+        assert PagedKVConfig(kv_dtype="int8").quantized
+
+
+# ---------------------------------------------------------------------------
+# Engine layer: paged cache init + call validation
+# ---------------------------------------------------------------------------
+
+class TestEnginePagedAPIs:
+    def test_init_paged_cache_validates_geometry(self, gpt2_engine):
+        pcfg = PagedKVConfig(block_size=8, num_blocks=64)
+        with pytest.raises(ValueError, match="multiple"):
+            gpt2_engine.init_paged_cache(3, 16, paged=pcfg)
+        n_pos = gpt2_engine.module.cfg.n_positions
+        with pytest.raises(ValueError, match="n_positions"):
+            gpt2_engine.init_paged_cache(8, n_pos + 1, paged=pcfg)
+        # a pool that cannot hold even ONE max-length request is an error
+        with pytest.raises(ValueError, match="usable blocks"):
+            gpt2_engine.init_paged_cache(
+                8, 32, paged=PagedKVConfig(block_size=8, num_blocks=4))
+
+    def test_paged_and_block_tables_go_together(self, gpt2_engine):
+        pcfg = PagedKVConfig(block_size=8, num_blocks=33)
+        cache = gpt2_engine.init_paged_cache(8, 32, paged=pcfg)
+        prompt = np.zeros((1, 4), np.int32)
+        with pytest.raises(ValueError, match="together"):
+            gpt2_engine.prefill_into_slots(cache, prompt, [0], paged=pcfg)
+        with pytest.raises(ValueError, match="together"):
+            gpt2_engine.decode_slots(
+                cache, np.zeros((8, 1), np.int32), np.ones((8,), bool),
+                block_tables=np.zeros((8, 4), np.int32))
+
+    def test_sized_down_pool_shrinks_kv_hbm(self, gpt2_engine):
+        """The memory claim at the byte level: a pool at ~half the dense
+        token capacity costs <= 0.5x the dense cache bytes; int8 storage
+        roughly halves it again (scales cost a little back)."""
+        dense = gpt2_engine.cache_hbm_bytes(
+            gpt2_engine.init_slot_cache(8, 32))
+        half_pool = PagedKVConfig(block_size=8, num_blocks=17)  # 16 usable
+        paged = gpt2_engine.cache_hbm_bytes(
+            gpt2_engine.init_paged_cache(8, 32, paged=half_pool))
+        int8 = gpt2_engine.cache_hbm_bytes(gpt2_engine.init_paged_cache(
+            8, 32, paged=PagedKVConfig(block_size=8, num_blocks=17,
+                                       kv_dtype="int8")))
+        assert paged <= 0.60 * dense  # 0.5x K/V + index/trash overhead
+        assert int8 < 0.70 * paged
+
+
+# ---------------------------------------------------------------------------
+# Parity: paged == dense, token for token
+# ---------------------------------------------------------------------------
+
+class TestPagedParity:
+    def test_mixed_traffic_parity_mesh_dp(self, gpt2_engine):
+        """THE acceptance property on the data=8 mesh: greedy decode
+        through block tables matches the fixed-batch reference token for
+        token, with more requests than slots so blocks are freed and
+        reused mid-run."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, n=20)
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 cache_mode="paged", block_size=8) as sched:
+            futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+            outs = [f.result(timeout=300) for f in futs]
+            s = sched.stats()
+            hist = sched.blocks_per_request_hist()
+        for (prompt, horizon), out in zip(reqs, outs):
+            np.testing.assert_array_equal(
+                out, _fixed_reference(gpt2_engine, prompt, horizon))
+        # every retired request returned its blocks
+        assert s["blocks_in_use"] == 0.0
+        assert s["blocks_high_water"] > 0.0
+        assert sum(hist.values()) == len(reqs)
+        assert s["blocks_per_request_max"] <= s["blocks_total"]
+
+    def test_parity_under_tensor_parallel_mesh(self, mesh_2d):
+        """Same parity on data=4 x tensor=2: pool heads shard over the
+        tensor axis (gpt2_cache_rules), block tables stay host-side."""
+        with ServeEngine("gpt2", mesh=mesh_2d, preset="tiny") as eng:
+            vocab = eng.module.cfg.vocab_size
+            reqs = _mixed_requests(vocab, n=10, seed=7)
+            with ContinuousScheduler(eng, num_slots=4, max_total_len=32,
+                                     cache_mode="paged",
+                                     block_size=8) as sched:
+                futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+                outs = [f.result(timeout=300) for f in futs]
+            for (prompt, horizon), out in zip(reqs, outs):
+                np.testing.assert_array_equal(
+                    out, _fixed_reference(eng, prompt, horizon))
+
+    def test_bfloat16_kv_dtype_is_exact(self, gpt2_engine):
+        """kv_dtype naming the COMPUTE dtype is a plain cast-through —
+        still bitwise, so still exact greedy parity."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, n=8, seed=3)
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 cache_mode="paged", block_size=8,
+                                 kv_dtype="bfloat16") as sched:
+            futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+            outs = [f.result(timeout=300) for f in futs]
+        for (prompt, horizon), out in zip(reqs, outs):
+            np.testing.assert_array_equal(
+                out, _fixed_reference(gpt2_engine, prompt, horizon))
+
+
+class TestInt8KV:
+    def test_int8_logits_close_to_dense(self):
+        """Model-layer tolerance: a prefill through the int8 pool must
+        reproduce the plain forward's logits within quantization error
+        (per-token scales, 127 levels)."""
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        model = GPT2(cfg)
+        tokens = np.asarray(jax.random.randint(
+            jax.random.key(1), (2, 6), 0, cfg.vocab_size))
+        params = model.init(jax.random.key(0), tokens)["params"]
+        full = model.apply({"params": params}, jnp.asarray(tokens))
+
+        pcfg = PagedKVConfig(block_size=4, num_blocks=9, kv_dtype="int8")
+        bt = np.zeros((4, 2), np.int32)
+        bt[3] = [1, 2]
+        bt[0] = [3, 4]
+        shapes = jax.eval_shape(lambda: model.init(
+            jax.random.key(0), jnp.zeros((4, 6), jnp.int32), decode=True,
+            slot_ids=jnp.arange(4), paged=pcfg,
+            block_tables=jnp.asarray(bt)))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        logits, _ = model.apply(
+            {"params": params, "cache": cache}, jnp.asarray(tokens),
+            decode=True, slot_ids=jnp.asarray([3, 0]), paged=pcfg,
+            block_tables=jnp.asarray(bt), mutable=["cache"])
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                                   rtol=0.0, atol=0.05)
+
+    @pytest.mark.serve_slow
+    def test_int8_end_to_end_completes(self, gpt2_engine):
+        """End-to-end int8 serving: all futures resolve with valid tokens
+        of the right shape (bitwise parity is not promised here)."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, n=10, seed=5)
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 cache_mode="paged", block_size=8,
+                                 kv_dtype="int8") as sched:
+            futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+            outs = [f.result(timeout=300) for f in futs]
+            s = sched.stats()
+        assert s["completed"] == float(len(reqs))
+        for (_, horizon), out in zip(reqs, outs):
+            assert out.shape == (horizon,)
+            assert (out >= 0).all() and (out < vocab).all()
+
+
+# ---------------------------------------------------------------------------
+# Backpressure + admission-time rejection
+# ---------------------------------------------------------------------------
+
+class TestBlockBackpressure:
+    def test_exhausted_pool_defers_admission_not_correctness(self,
+                                                             gpt2_engine):
+        """A pool that fits only ONE request's worst case serializes
+        admission (later requests wait for retirement's bulk-free) but
+        every stream still matches the reference — backpressure, not
+        corruption."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(11)
+        reqs = [(rng.integers(0, vocab, size=(6,), dtype=np.int32), 6)
+                for _ in range(3)]
+        # worst case per request: blocks_for(6 + 6 - 1) = 3 of size 4;
+        # 5 usable blocks -> the second request cannot co-reside.
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=16,
+                                 cache_mode="paged", block_size=4,
+                                 num_blocks=6) as sched:
+            futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+            outs = [f.result(timeout=300) for f in futs]
+            s = sched.stats()
+        assert s["blocks_high_water"] <= 5.0
+        assert s["completed"] == 3.0
+        for (prompt, horizon), out in zip(reqs, outs):
+            np.testing.assert_array_equal(
+                out, _fixed_reference(gpt2_engine, prompt, horizon))
+
+    def test_pool_too_small_for_one_request_rejected_at_init(self,
+                                                             gpt2_engine):
+        """A pool that cannot hold even one max-length request is a
+        config error at CONSTRUCTION — nothing could ever decode, so it
+        must not wait for a submit to fail."""
+        with pytest.raises(ValueError, match="usable blocks"):
+            ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                cache_mode="paged", block_size=4,
+                                num_blocks=4, start=False)
+
+    def test_submit_rejects_empty_prompt(self, gpt2_engine):
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=16,
+                                 start=False) as sched:
+            with pytest.raises(ValueError, match="at least one token"):
+                sched.submit(np.zeros((0,), np.int32), max_new_tokens=4)
+
+    def test_submit_rejects_overlong_request_in_both_modes(self,
+                                                           gpt2_engine):
+        for kw in ({}, {"cache_mode": "paged", "block_size": 4}):
+            with ContinuousScheduler(gpt2_engine, num_slots=8,
+                                     max_total_len=16, start=False,
+                                     **kw) as sched:
+                with pytest.raises(ValueError, match="max_total_len"):
+                    sched.submit(np.zeros((12,), np.int32),
+                                 max_new_tokens=8)
+
+    def test_scheduler_config_validation(self, gpt2_engine):
+        with pytest.raises(ValueError, match="cache_mode"):
+            ContinuousScheduler(gpt2_engine, cache_mode="virtual",
+                                start=False)
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousScheduler(gpt2_engine, cache_mode="dense",
+                                kv_dtype="int8", start=False)
+
+
+# ---------------------------------------------------------------------------
+# Block gauges on the stats / monitor surface
+# ---------------------------------------------------------------------------
+
+class TestBlockGauges:
+    def test_dense_reports_trivially_full_pool(self, gpt2_engine):
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 block_size=8) as sched:
+            out = sched.submit(np.arange(4, dtype=np.int32),
+                               max_new_tokens=2).result(timeout=300)
+            s = sched.stats()
+            hist = sched.blocks_per_request_hist()
+        assert len(out) == 2
+        per_slot = 32 // 8
+        assert s["blocks_total"] == float(8 * per_slot)
+        assert s["blocks_in_use"] == s["blocks_total"]
+        assert s["blocks_free"] == 0.0
+        assert s["block_utilization"] == 1.0
+        # dense: every request pins a full slot row for its lifetime
+        assert hist == {per_slot: 1}
+        assert s["kv_hbm_bytes"] > 0.0
+
+    def test_monitor_logs_block_line(self, gpt2_engine, caplog):
+        from distributed_tensorflow_tpu.obs import ServeMonitorHook
+
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 cache_mode="paged", block_size=8) as sched:
+            hook = ServeMonitorHook(sched, every_steps=1)
+            sched.submit(np.arange(5, dtype=np.int32),
+                         max_new_tokens=3).result(timeout=300)
+            m = hook.metrics()
+            with caplog.at_level(
+                    logging.INFO,
+                    logger="distributed_tensorflow_tpu.obs.serve"):
+                hook.log(1)
+        for key in ("serve_blocks_total", "serve_blocks_free",
+                    "serve_block_utilization", "serve_blocks_high_water",
+                    "serve_blocks_per_request_mean", "serve_kv_hbm_bytes"):
+            assert key in m, m
+        assert any("kv blocks=" in r.message and "util=" in r.message
+                   for r in caplog.records)

@@ -70,15 +70,42 @@ def test_serve_entrypoint_continuous_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_paged_int8_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--cache_mode=paged", "--block_size=8",
+                "--kv_dtype=int8", "--num_slots=8", "--steps=16",
+                "--prompt_lens=6,8", "--max_new_tokens=6",
+                "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    assert out["cache_mode"] == "paged"
+    assert out["kv_dtype"] == "int8"
+    assert out["completed"] == 16
+    assert out["kv_hbm_bytes"] > 0
+    assert out["block_size"] == 8
+    assert 0 < out["blocks_high_water"] <= out["blocks_total"]
+    assert out["blocks_per_request_mean"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
     for key in ("metric", "value", "unit", "vs_baseline",
                 "p50_latency_ms", "p99_latency_ms",
                 "ttft_p50_ms", "tpot_mean_ms", "slot_occupancy",
-                "fixed_tokens_per_sec", "continuous_speedup"):
+                "fixed_tokens_per_sec", "continuous_speedup",
+                "paged_tokens_per_sec", "paged_speedup",
+                "paged_int8_tokens_per_sec", "kv_hbm_bytes",
+                "kv_hbm_ratio_paged", "kv_hbm_ratio_paged_int8",
+                "block_size", "num_blocks", "block_utilization"):
         assert key in out, f"missing {key!r} in {out}"
     assert out["unit"] == "tokens/sec"
     assert out["value"] > 0
     assert out["fixed_tokens_per_sec"] > 0
+    assert out["paged_tokens_per_sec"] > 0
     assert "serve_tokens_per_sec" in out["metric"]
+    # the memory claim: paged <= 0.5x dense cache bytes, int8 <= 0.25x
+    assert out["kv_hbm_bytes"]["paged"] < out["kv_hbm_bytes"]["dense"]
+    assert out["kv_hbm_ratio_paged"] <= 0.5
+    assert out["kv_hbm_ratio_paged_int8"] <= 0.25

@@ -128,6 +128,8 @@ def _serve_bench(flags):
     import jax
 
     from distributed_tensorflow_tpu import cluster as cluster_lib
+    from distributed_tensorflow_tpu.obs import (default_tracer,
+                                                write_chrome_trace)
     from distributed_tensorflow_tpu.serve import (ServeArgs, ServeEngine,
                                                   run_serve)
 
@@ -177,6 +179,10 @@ def _serve_bench(flags):
     engine = ServeEngine("gpt2", mesh=mesh,
                          checkpoint_dir=flags.checkpoint_dir,
                          seed=fixed.seed, preset=preset)
+    # Flight-recorder smoke: every bench run exercises the tracing path
+    # (spans are host-side only, so throughput numbers are unaffected).
+    tracer = default_tracer()
+    tracer.enable()
     try:
         fixed_res = run_serve(fixed, engine=engine)
         cont_res = run_serve(continuous, engine=engine)
@@ -184,6 +190,9 @@ def _serve_bench(flags):
         int8_res = run_serve(paged_int8, engine=engine)
     finally:
         engine.close()
+    trace_events = len(tracer)
+    if flags.trace_out:
+        trace_events = write_chrome_trace(flags.trace_out)
 
     metric = ("gpt2_serve_tokens_per_sec" if on_tpu
               else "gpt2_tiny_cpu_smoke_serve_tokens_per_sec")
@@ -227,6 +236,9 @@ def _serve_bench(flags):
         "block_utilization": round(
             paged_res["blocks_high_water"]
             / max(paged_res["blocks_total"], 1), 4),
+        "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
+        "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
+        "trace_events": trace_events,
         "requests": cont_res["requests"],
         "completed": cont_res["completed"],
         "checkpoint_step": cont_res["checkpoint_step"],
@@ -246,6 +258,10 @@ def main(argv=None):
     ap.add_argument("--checkpoint_dir", default=None,
                     help="serve mode: checkpoint to serve (fresh init when "
                          "unset)")
+    ap.add_argument("--trace_out", default="",
+                    help="serve mode: also write the Chrome trace-event "
+                         "JSON here (tracing runs either way; the JSON "
+                         "line carries trace_events)")
     ap.add_argument("--input", choices=("cached", "loader", "both"),
                     default="cached")
     ap.add_argument("--records", type=int, default=1024,

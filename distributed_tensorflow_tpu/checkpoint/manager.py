@@ -13,14 +13,29 @@ automatically.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 from etils import epath
 
+from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+from distributed_tensorflow_tpu.obs.trace import default_tracer
+
 logger = logging.getLogger(__name__)
 PyTree = Any
+
+
+def _ckpt_instruments(registry=None):
+    r = registry or obs_metrics.default_registry()
+    return {
+        "save": r.histogram(
+            "dtt_checkpoint_save_seconds",
+            "save() host-side duration (async: dispatch, not completion)"),
+        "restore": r.histogram(
+            "dtt_checkpoint_restore_seconds", "restore() duration"),
+    }
 
 
 class CheckpointManager:
@@ -42,6 +57,8 @@ class CheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mngr = ocp.CheckpointManager(self._directory, options=self._options)
+        self._obs = _ckpt_instruments()
+        self._tracer = default_tracer()
 
     # -- tf.train.CheckpointManager-compatible surface -----------------------
     @property
@@ -64,9 +81,14 @@ class CheckpointManager:
         save was started, honoring save_interval_steps like TF's manager)."""
         if step in self._mngr.all_steps():
             return False
+        t0 = time.monotonic()
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        t1 = time.monotonic()
+        self._obs["save"].observe(t1 - t0)
+        self._tracer.add_span("checkpoint_save", cat="checkpoint",
+                              start=t0, end=t1, args={"step": int(step)})
         if saved:
             logger.info("checkpoint save started at step %d -> %s", step,
                         self.directory)
@@ -83,7 +105,14 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"No checkpoint found in {self.directory}")
         abstract = jax.tree.map(_abstractify, template)
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        t0 = time.monotonic()
+        out = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        t1 = time.monotonic()
+        self._obs["restore"].observe(t1 - t0)
+        self._tracer.add_span("checkpoint_restore", cat="checkpoint",
+                              start=t0, end=t1, args={"step": int(step)})
+        return out
 
     def restore_or_init(self, state: PyTree) -> PyTree:
         """Resume-if-present: the auto-resume contract of fault tolerance
@@ -106,7 +135,12 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        t0 = time.monotonic()
         tree = self._mngr.restore(step, args=ocp.args.StandardRestore())
+        t1 = time.monotonic()
+        self._obs["restore"].observe(t1 - t0)
+        self._tracer.add_span("checkpoint_restore", cat="checkpoint",
+                              start=t0, end=t1, args={"step": int(step)})
         # A TrainState round-trips through StandardSave as a dict of its
         # pytree fields; tolerate an attr-style container too.
         if isinstance(tree, dict):

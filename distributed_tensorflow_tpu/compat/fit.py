@@ -153,6 +153,38 @@ class _CallbackBridge(Hook):
             loop.request_stop()
 
 
+def _check_per_host_batches(it, host_bs: int, process_count: int):
+    """Validate the first batch of a multi-host fit(tf.data.Dataset) feed.
+
+    Yields ``it`` unchanged, but the first batch's leading dimensions must
+    equal ``host_bs`` — a global-batched dataset fed per-host is the classic
+    multi-host porting bug, and letting it through only fails later (or
+    worse, trains on a silently desynced global batch)."""
+    first = True
+    try:
+        for batch in it:
+            if first:
+                first = False
+                bad = {k: int(np.asarray(v).shape[0])
+                       for k, v in batch.items()
+                       if np.asarray(v).ndim and
+                       int(np.asarray(v).shape[0]) != host_bs}
+                if bad:
+                    raise ValueError(
+                        f"fit(tf.data.Dataset) on {process_count} hosts: "
+                        f"the first batch has leading dim(s) {bad} but "
+                        f"each host must yield PER-HOST batches of "
+                        f"{host_bs} rows.  A pre-built dataset is usually "
+                        "GLOBAL-batched (keras convention); pass a "
+                        "dataset_fn through data.tf_dataset_data_fn "
+                        "(which shards before batching) instead.")
+            yield batch
+    finally:
+        close = getattr(it, "close", None)
+        if callable(close):
+            close()
+
+
 class Model:
     """``Model.fit`` over a workload (see module docstring for the port
     contract).  ``workload`` is a ``models.Workload`` instance or a model
@@ -217,15 +249,24 @@ class Model:
             if for_training and not self._built_for_training:
                 # Built by evaluate()/load_weights() with a placeholder
                 # horizon: rebuild the optimizer around the REAL horizon and
-                # carry the weights over (no training has happened, so the
-                # fresh opt_state loses nothing).
+                # carry the restored state over.
                 old = self.state
                 self.state = None
                 self._rebuild(total_steps)
-                self.state = self.state.replace(
-                    params=old.params, model_state=old.model_state,
-                    step=old.step,
-                )
+                carry = dict(params=old.params, model_state=old.model_state,
+                             step=old.step)
+                if int(jax.device_get(old.step)) > 0:
+                    # Mid-training checkpoint (load_weights of a trained
+                    # run): its opt_state holds real optimizer moments and
+                    # the schedule position — dropping it would silently
+                    # reset Adam and restart LR decay.  The schedule fn
+                    # lives in the rebuilt tx closure (not in opt_state),
+                    # so the restored counts remain valid under the new
+                    # horizon.
+                    carry["opt_state"] = old.opt_state
+                # step==0: no training has happened, so the fresh
+                # opt_state loses nothing.
+                self.state = self.state.replace(**carry)
                 self._built_for_training = True
             return
         self._rebuild(total_steps)
@@ -270,18 +311,17 @@ class Model:
                 tf_dataset_data_fn,
             )
 
+            it = tf_dataset_data_fn(lambda bs: x)(host_bs)
             if jax.process_count() > 1:
                 # A pre-built dataset's batch size is whatever the user
                 # chose — usually the GLOBAL batch (keras convention).  The
                 # adapter can shard batches across hosts but cannot
-                # re-batch them to the per-host size this trainer needs.
-                logger.warning(
-                    "fit(tf.data.Dataset) on %d hosts: the dataset must "
-                    "yield PER-HOST batches of %d rows on each host; for a "
-                    "global-batched dataset pass a dataset_fn through "
-                    "data.tf_dataset_data_fn (which shards before "
-                    "batching) instead", jax.process_count(), host_bs)
-            return tf_dataset_data_fn(lambda bs: x)(host_bs)
+                # re-batch them to the per-host size this trainer needs, so
+                # a wrong size here desyncs the global batch silently:
+                # check the first yielded batch and fail loudly.
+                return _check_per_host_batches(
+                    it, host_bs, jax.process_count())
+            return it
         if callable(x):  # a data_fn
             return x(host_bs)
         return iter(x)  # an iterator/iterable of batch dicts

@@ -38,6 +38,12 @@ mechanisms close it:
   ``interval_s`` (registration is idempotent).  This covers the
   journal-less / journal-lost dispatcher restart, and is cheap: one short
   TCP exchange per worker per interval, metadata plane only.
+- ``expire_after_s=``: heartbeats double as liveness — a worker whose last
+  registration is older than the window is pruned from the list served to
+  clients, stale journal entries are dropped at replay, and the journal is
+  compacted to the live set (tf.data service ``worker_timeout_ms`` role).
+  Journal lines gain a timestamp (``R <addr> <unix_ts>``); legacy
+  two-field lines still replay, treated as fresh.
 
 Wire protocol (dispatcher, line-oriented, one request per connection):
 
@@ -51,7 +57,8 @@ import logging
 import os
 import socket
 import threading
-from typing import Iterator, List, Optional
+import time
+from typing import Dict, Iterator, List, Optional
 
 from distributed_tensorflow_tpu.data.service import (
     DataServiceError,
@@ -66,7 +73,8 @@ class DataServiceDispatcher:
     """Worker registry (tf.data service dispatcher role, metadata only)."""
 
     def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 expire_after_s: Optional[float] = None):
         self._sock = socket.create_server((host, port))
         self._host = host
         self._port = self._sock.getsockname()[1]
@@ -75,16 +83,54 @@ class DataServiceDispatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._journal_path = journal_path
+        # Worker expiry (tf.data service DispatcherConfig
+        # worker_timeout_ms role): a worker whose last registration —
+        # heartbeats re-register — is older than ``expire_after_s`` is
+        # dropped from the list served to clients, so a fleet that loses a
+        # machine stops handing its address to late joiners.  None (the
+        # default) keeps the historical never-prune behavior.
+        self._expire_after_s = expire_after_s
+        self._last_seen: Dict[str, float] = {}   # addr -> monotonic
+        self._journal_ts: Dict[str, float] = {}  # addr -> wall clock
         if journal_path and os.path.exists(journal_path):
-            with open(journal_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line.startswith("R ") and line[2:] not in self._workers:
-                        self._workers.append(line[2:])
-            if self._workers:
-                logger.info(
-                    "dispatcher: replayed %d worker registration(s) from "
-                    "journal %s", len(self._workers), journal_path)
+            self._replay_journal(journal_path)
+
+    def _replay_journal(self, journal_path: str) -> None:
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        entries: Dict[str, float] = {}  # addr -> newest journaled wall ts
+        lines = 0
+        with open(journal_path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0] == "R":
+                    lines += 1
+                    # Legacy journals carry no timestamp ("R <addr>"):
+                    # treat the entry as fresh — it gets one full expiry
+                    # window to heartbeat before being pruned.
+                    ts = float(parts[2]) if len(parts) >= 3 else now_wall
+                    entries[parts[1]] = max(entries.get(parts[1], 0.0), ts)
+        dropped = 0
+        for addr, ts in entries.items():
+            age = now_wall - ts
+            if (self._expire_after_s is not None
+                    and age > self._expire_after_s):
+                dropped += 1
+                continue
+            self._workers.append(addr)
+            # Map the journaled wall-clock age onto the monotonic clock so
+            # a replayed worker keeps only its REMAINING expiry window.
+            self._last_seen[addr] = now_mono - max(0.0, age)
+            self._journal_ts[addr] = ts
+        if self._workers:
+            logger.info(
+                "dispatcher: replayed %d worker registration(s) from "
+                "journal %s (%d stale dropped)",
+                len(self._workers), journal_path, dropped)
+        if dropped or lines != len(self._workers):
+            # Stale or duplicate lines: compact to the live set so the
+            # journal stays bounded by fleet size, not by uptime.
+            self._compact_journal()
 
     def _append_journal(self, addr: str) -> None:
         if not self._journal_path:
@@ -92,10 +138,43 @@ class DataServiceDispatcher:
         # Append + fsync before acking: a registration the worker believes
         # in must survive a dispatcher crash (the tf.data service journal
         # contract).
+        ts = time.time()
         with open(self._journal_path, "a") as f:
-            f.write(f"R {addr}\n")
+            f.write(f"R {addr} {ts:.3f}\n")
             f.flush()
             os.fsync(f.fileno())
+        self._journal_ts[addr] = ts
+
+    def _compact_journal(self) -> None:
+        """Atomically rewrite the journal to the current live set."""
+        if not self._journal_path:
+            return
+        tmp = self._journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            for addr in self._workers:
+                ts = self._journal_ts.get(addr) or time.time()
+                f.write(f"R {addr} {ts:.3f}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._journal_path)
+
+    def _prune_locked(self) -> None:
+        """Drop workers not seen within the expiry window (lock held)."""
+        if self._expire_after_s is None:
+            return
+        now = time.monotonic()
+        dead = [a for a in self._workers
+                if now - self._last_seen.get(a, now) > self._expire_after_s]
+        if not dead:
+            return
+        for addr in dead:
+            self._workers.remove(addr)
+            self._last_seen.pop(addr, None)
+            self._journal_ts.pop(addr, None)
+            logger.info(
+                "dispatcher: expired worker %s (no heartbeat in %.1fs)",
+                addr, self._expire_after_s)
+        self._compact_journal()
 
     @property
     def target(self) -> str:
@@ -104,6 +183,7 @@ class DataServiceDispatcher:
     @property
     def workers(self) -> List[str]:
         with self._lock:
+            self._prune_locked()
             return list(self._workers)
 
     def start(self) -> "DataServiceDispatcher":
@@ -132,6 +212,19 @@ class DataServiceDispatcher:
                             new = addr not in self._workers
                             if new:
                                 self._workers.append(addr)
+                            self._last_seen[addr] = time.monotonic()
+                            rejournal = new
+                            if (not new and self._journal_path
+                                    and self._expire_after_s is not None):
+                                # Heartbeat keep-alive durability: refresh
+                                # the journaled timestamp, throttled to
+                                # half the expiry window so the journal
+                                # isn't rewritten every beat.
+                                rejournal = (
+                                    time.time()
+                                    - self._journal_ts.get(addr, 0.0)
+                                    > self._expire_after_s / 2)
+                            if rejournal:
                                 self._append_journal(addr)
                         if new:
                             logger.info(
@@ -139,6 +232,7 @@ class DataServiceDispatcher:
                         conn.sendall(b"OK\n")
                     elif req == "L":
                         with self._lock:
+                            self._prune_locked()
                             line = " ".join(self._workers)
                         conn.sendall(line.encode() + b"\n")
                     else:

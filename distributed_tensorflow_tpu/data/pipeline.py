@@ -169,6 +169,14 @@ class DevicePrefetchIterator:
             max_workers=self._transfer_workers,
             thread_name_prefix="dtt-transfer",
         )
+        # Registry bridge: the monitor hook reads this namespace instead of
+        # scraping the iterator directly.  Lazy import — obs pulls in
+        # training.loop, and data.pipeline must stay importable first.
+        from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+
+        self._obs_registry = obs_metrics.default_registry()
+        self.obs_namespace = self._obs_registry.register_stats(
+            "prefetch", self.stats)
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
@@ -243,6 +251,9 @@ class DevicePrefetchIterator:
             }
 
     def close(self):
+        if self.obs_namespace:
+            self._obs_registry.unregister_stats(self.obs_namespace)
+            self.obs_namespace = None
         with self._lock:
             self._done = True
             # Unblock the producer and drop queued work so join() is fast.

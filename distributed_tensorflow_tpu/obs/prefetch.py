@@ -16,25 +16,43 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from distributed_tensorflow_tpu.obs.metrics import Registry, default_registry
 from distributed_tensorflow_tpu.training.loop import Hook
 
 logger = logging.getLogger(__name__)
 
 
 class PrefetchMonitorHook(Hook):
-    """Snapshots ``data_iter.stats()`` into ``loop.last_logged_metrics``
-    (prefixed ``prefetch_``) and the log every ``every_steps`` steps."""
+    """Snapshots the iterator's counters into ``loop.last_logged_metrics``
+    (prefixed ``prefetch_``) and the log every ``every_steps`` steps.
 
-    def __init__(self, data_iter, *, every_steps: int = 100):
+    Thin reader of the registry's stats-provider bridge: ``data_iter``
+    may be a namespace string, an object carrying ``obs_namespace``
+    (``DevicePrefetchIterator`` registers itself at construction), or —
+    legacy — anything with a callable ``stats()``.  Log format unchanged.
+    """
+
+    def __init__(
+        self, data_iter, *, every_steps: int = 100,
+        registry: Optional[Registry] = None,
+    ):
         self._iter = data_iter
+        self._registry = registry or default_registry()
         self.every_steps = max(1, every_steps)
         self.last_stats: Dict[str, float] = {}
 
     def _snapshot(self) -> Optional[Dict[str, float]]:
-        stats = getattr(self._iter, "stats", None)
-        if not callable(stats):
+        if isinstance(self._iter, str):
+            s = self._registry.stats(self._iter)
+        else:
+            ns = getattr(self._iter, "obs_namespace", None)
+            fn = self._registry.provider(ns) if ns else None
+            if fn is None:
+                fn = getattr(self._iter, "stats", None)
+            s = fn() if callable(fn) else None
+        if s is None:
             return None
-        self.last_stats = stats()
+        self.last_stats = s
         return self.last_stats
 
     def after_step(self, loop, step, metrics):

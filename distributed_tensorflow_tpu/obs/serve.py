@@ -17,25 +17,45 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from distributed_tensorflow_tpu.obs.metrics import Registry, default_registry
 from distributed_tensorflow_tpu.training.loop import Hook
 
 logger = logging.getLogger(__name__)
 
 
 class ServeMonitorHook(Hook):
-    """Snapshots ``source.stats()`` (prefixed ``serve_``) every
-    ``every_steps`` requests/steps."""
+    """Snapshots the source's stats (prefixed ``serve_``) every
+    ``every_steps`` requests/steps.
 
-    def __init__(self, source, *, every_steps: int = 100):
+    The hook is a thin reader of the metrics registry's stats-provider
+    bridge: ``source`` may be a namespace string (looked up in
+    ``registry``), or a component carrying an ``obs_namespace`` attribute
+    (``DynamicBatcher``/``ContinuousScheduler`` register their ``stats``
+    at construction), or — the legacy escape hatch — any object with a
+    callable ``stats()``.  The log-line formats are unchanged either way.
+    """
+
+    def __init__(
+        self, source, *, every_steps: int = 100,
+        registry: Optional[Registry] = None,
+    ):
         self._source = source
+        self._registry = registry or default_registry()
         self.every_steps = max(1, every_steps)
         self.last_stats: Dict[str, float] = {}
 
     def _snapshot(self) -> Optional[Dict[str, float]]:
-        stats = getattr(self._source, "stats", None)
-        if not callable(stats):
+        if isinstance(self._source, str):
+            s = self._registry.stats(self._source)
+        else:
+            ns = getattr(self._source, "obs_namespace", None)
+            fn = self._registry.provider(ns) if ns else None
+            if fn is None:
+                fn = getattr(self._source, "stats", None)
+            s = fn() if callable(fn) else None
+        if s is None:
             return None
-        self.last_stats = stats()
+        self.last_stats = s
         return self.last_stats
 
     def metrics(self) -> Dict[str, float]:

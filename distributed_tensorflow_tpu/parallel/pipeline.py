@@ -227,6 +227,18 @@ def pipeline_value_and_grad(
     (remat and per-microbatch ``loss/M`` accumulation reorder the ops, so
     exact-equality golden tests against "gpipe" will not hold) — only
     peak memory and the remat FLOPs differ materially.
+
+    1F1B cost caveat — S× tail compute: the uniform-tick design (every
+    stage runs the same program every tick, required so the collectives
+    inside ``stage_fn`` never sit in branch-divergent control flow) means
+    ``tail_fn``/``loss_fn`` also run on EVERY stage's activations each
+    tick, masked to zero on all but the last stage.  The tail's FLOPs are
+    therefore paid S times, not once.  Fine while the tail is small
+    relative to a stage (a final LN + small head, an MSE/CE reduction);
+    for a tail whose cost rivals a stage — e.g. a large-vocab LM head —
+    the wasted (S-1)/S of its compute shows up directly in step time, so
+    keep such a head OUT of ``tail_fn`` (compose it outside the schedule
+    via the jax.vjp recipe above) or accept the overhead knowingly.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule: {schedule!r}")
@@ -297,7 +309,9 @@ def pipeline_value_and_grad(
         # ~ 1 fwd + (remat fwd + bwd): the standard 1F1B remat trade.
         # NOTE: tail_fn/loss_fn run (masked) on EVERY stage's
         # activations, so they must be finite on intermediate values
-        # (softmax-CE, MSE etc. are; a log of a raw activation is not).
+        # (softmax-CE, MSE etc. are; a log of a raw activation is not) —
+        # and their FLOPs are paid S times (see the S× tail-compute
+        # caveat in pipeline_value_and_grad's docstring).
         # Stash ring: slot m % R; stage 0 frees slot (m-R) the same tick
         # forward rewrites it — backward reads BEFORE forward writes below.
         def tick(carry, t):

@@ -37,7 +37,31 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
 
+from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+
 logger = logging.getLogger(__name__)
+
+
+def _serve_instruments(registry: Optional[obs_metrics.Registry] = None):
+    """Get-or-create the shared serve metric families (process-global by
+    default, so every batcher/scheduler instance reports into one set)."""
+    r = registry or obs_metrics.default_registry()
+    return {
+        "submitted": r.counter(
+            "dtt_serve_requests_submitted_total", "Requests accepted"),
+        "rejected": r.counter(
+            "dtt_serve_requests_rejected_total",
+            "Requests refused by admission control"),
+        "completed": r.counter(
+            "dtt_serve_requests_completed_total", "Requests resolved"),
+        "failed": r.counter(
+            "dtt_serve_requests_failed_total", "Requests failed"),
+        "depth": r.gauge(
+            "dtt_serve_queue_depth", "Pending requests awaiting scheduling"),
+        "queue_wait": r.histogram(
+            "dtt_serve_queue_wait_seconds",
+            "Submit-to-scheduling wait per request"),
+    }
 
 
 class ServeOverloadedError(RuntimeError):
@@ -99,6 +123,9 @@ class DynamicBatcher:
             self._scheduler = scheduler
             self._stopped = False
             self._lock = threading.Lock()
+            # Thin-reader contract: the hook resolves our namespace to the
+            # scheduler's registered stats provider.
+            self.obs_namespace = getattr(scheduler, "obs_namespace", None)
             return
         self._scheduler = None
         if run_batch is None:
@@ -129,6 +156,12 @@ class DynamicBatcher:
         self._occupancy_sum = 0
         self._last_occupancy = 0
         self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
+        self._queue_wait_ms: collections.deque = collections.deque(maxlen=1024)
+        self._obs = _serve_instruments()
+        self._obs_registry = obs_metrics.default_registry()
+        self.obs_namespace = self._obs_registry.register_stats(
+            f"serve/{name}", self.stats
+        )
         self._thread = threading.Thread(
             target=self._scheduler_loop, daemon=True, name=f"{name}-batcher"
         )
@@ -154,6 +187,7 @@ class DynamicBatcher:
                 raise RuntimeError("DynamicBatcher is closed")
             if self._depth >= self.max_queue_size:
                 self._rejected += 1
+                self._obs["rejected"].inc()
                 raise ServeOverloadedError(
                     f"serve queue full ({self._depth}/{self.max_queue_size} "
                     "pending); back off and retry"
@@ -164,6 +198,8 @@ class DynamicBatcher:
             )
             self._depth += 1
             self._submitted += 1
+            self._obs["submitted"].inc()
+            self._obs["depth"].set(self._depth)
             self._cond.notify()
         return fut
 
@@ -175,6 +211,7 @@ class DynamicBatcher:
             return self._scheduler.stats()
         with self._lock:
             lat = sorted(self._latencies_ms)
+            qw = sorted(self._queue_wait_ms)
             batches = self._batches
             return {
                 "queue_depth": float(self._depth),
@@ -190,6 +227,8 @@ class DynamicBatcher:
                 "last_batch_occupancy": float(self._last_occupancy),
                 "p50_latency_ms": _percentile(lat, 0.50),
                 "p99_latency_ms": _percentile(lat, 0.99),
+                "queue_wait_p50_ms": _percentile(qw, 0.50),
+                "queue_wait_p99_ms": _percentile(qw, 0.99),
             }
 
     def close(self, timeout: float = 10.0) -> None:
@@ -208,6 +247,8 @@ class DynamicBatcher:
                 return
             self._stopped = True
             self._cond.notify_all()
+        if self.obs_namespace:
+            self._obs_registry.unregister_stats(self.obs_namespace)
         self._thread.join(timeout)
         with self._cond:
             leftover = [r for q in self._pending.values() for r in q]
@@ -265,6 +306,13 @@ class DynamicBatcher:
             self._dispatch(batch)
 
     def _dispatch(self, reqs: List[_Request]) -> None:
+        started = time.monotonic()
+        with self._lock:
+            for r in reqs:
+                wait_s = started - r.enqueued
+                self._queue_wait_ms.append(wait_s * 1000.0)
+                self._obs["queue_wait"].observe(wait_s)
+            self._obs["depth"].set(self._depth)
         error: Optional[BaseException] = None
         results: List[Any] = []
         try:
@@ -283,8 +331,10 @@ class DynamicBatcher:
             self._last_occupancy = len(reqs)
             if error is None:
                 self._completed += len(reqs)
+                self._obs["completed"].inc(len(reqs))
             else:
                 self._failed += len(reqs)
+                self._obs["failed"].inc(len(reqs))
             for r in reqs:
                 self._latencies_ms.append((done - r.enqueued) * 1000.0)
         if error is not None:

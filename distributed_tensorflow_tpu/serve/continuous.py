@@ -42,13 +42,39 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+from distributed_tensorflow_tpu.obs.trace import default_tracer
 from distributed_tensorflow_tpu.serve.batcher import (
     ServeOverloadedError,
     _percentile,
+    _serve_instruments,
 )
 from distributed_tensorflow_tpu.serve.paged import BlockAllocator
 
 logger = logging.getLogger(__name__)
+
+
+def _continuous_instruments(registry=None):
+    """The iteration-level families on top of the shared serve set."""
+    r = registry or obs_metrics.default_registry()
+    out = _serve_instruments(r)
+    out.update({
+        "admissions": r.counter(
+            "dtt_serve_admissions_total", "Requests admitted into slots"),
+        "retirements": r.counter(
+            "dtt_serve_retirements_total", "Slots retired"),
+        "ttft": r.histogram(
+            "dtt_serve_ttft_seconds", "Submit to first generated token"),
+        "tpot": r.histogram(
+            "dtt_serve_tpot_seconds", "Per-output-token decode cadence",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 0.5, 1.0)),
+        "request": r.histogram(
+            "dtt_serve_request_seconds", "Submit to retirement"),
+        "active_slots": r.gauge(
+            "dtt_serve_active_slots", "Slots currently decoding"),
+    })
+    return out
 
 
 @dataclasses.dataclass
@@ -68,6 +94,11 @@ class _SlotRequest:
     # that have NOT been physically allocated yet (released as the slot's
     # length crosses block boundaries, or at retirement).
     reserved_blocks: int = 0
+    # Tracing: request id (the trace's tid — one Perfetto lane per
+    # request) and when this request, at head of line, first failed paged
+    # block admission (the reservation-wait span's start).
+    rid: int = 0
+    blocked_since: Optional[float] = None
 
     def done(self) -> bool:
         if len(self.tokens) >= self.max_new_tokens:
@@ -191,6 +222,13 @@ class ContinuousScheduler:
         self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
         self._ttft_ms: collections.deque = collections.deque(maxlen=1024)
         self._tpot_ms: collections.deque = collections.deque(maxlen=1024)
+        self._queue_wait_ms: collections.deque = collections.deque(maxlen=1024)
+        self._obs = _continuous_instruments()
+        self._obs_registry = obs_metrics.default_registry()
+        self.obs_namespace = self._obs_registry.register_stats(
+            f"serve/{name}", self.stats
+        )
+        self._tracer = default_tracer()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=name)
         if start:
@@ -242,11 +280,15 @@ class ContinuousScheduler:
                 raise RuntimeError("ContinuousScheduler is closed")
             if len(self._queue) >= self.max_queue_size:
                 self._rejected += 1
+                self._obs["rejected"].inc()
                 raise ServeOverloadedError(
                     f"admission queue full ({len(self._queue)}/"
                     f"{self.max_queue_size} queued); back off and retry")
             self._queue.append(req)
             self._submitted += 1
+            req.rid = self._submitted
+            self._obs["submitted"].inc()
+            self._obs["depth"].set(len(self._queue))
             self._cond.notify()
         return req.future
 
@@ -307,6 +349,7 @@ class ContinuousScheduler:
             lat = sorted(self._latencies_ms)
             ttft = sorted(self._ttft_ms)
             tpot = self._tpot_ms
+            qw = sorted(self._queue_wait_ms)
             iters = self._iterations
             return {
                 **self._block_stats(),
@@ -334,6 +377,8 @@ class ContinuousScheduler:
                 "ttft_p50_ms": _percentile(ttft, 0.50),
                 "ttft_p99_ms": _percentile(ttft, 0.99),
                 "tpot_mean_ms": (sum(tpot) / len(tpot)) if tpot else 0.0,
+                "queue_wait_p50_ms": _percentile(qw, 0.50),
+                "queue_wait_p99_ms": _percentile(qw, 0.99),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -345,6 +390,8 @@ class ContinuousScheduler:
                 return
             self._stopped = True
             self._cond.notify_all()
+        if self.obs_namespace:
+            self._obs_registry.unregister_stats(self.obs_namespace)
         if self._thread.is_alive():
             self._thread.join(timeout)
         with self._cond:
@@ -389,6 +436,13 @@ class ContinuousScheduler:
                                 req.max_written_tokens())
                             self._reserved += req.reserved_blocks
                         admits.append(req)
+                    if (self.paged is not None and self._queue
+                            and self._free
+                            and self._queue[0].blocked_since is None):
+                        # Head of line is waiting on BLOCKS, not slots:
+                        # start its reservation-wait span.
+                        self._queue[0].blocked_since = time.monotonic()
+                    self._obs["depth"].set(len(self._queue))
                 self._admit(admits)
                 self._decode_once()
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
@@ -399,6 +453,7 @@ class ContinuousScheduler:
                 self._queue.clear()
                 self._active.clear()
                 self._failed += len(doomed)
+                self._obs["failed"].inc(len(doomed))
             for req in doomed:
                 if not req.future.done():
                     req.future.set_exception(e)
@@ -442,8 +497,20 @@ class ContinuousScheduler:
         one request at a time — each (1, T_prompt) program compiles once
         per prompt length, and a single-row prefill touches only that
         slot's rows of the resident cache."""
-        now = time.monotonic()
         for req in admits:
+            prefill_start = time.monotonic()
+            queue_wait_s = prefill_start - req.submitted
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    "queue_wait", cat="serve", tid=req.rid,
+                    start=req.submitted, end=prefill_start,
+                    args={"request_id": req.rid, "slot": req.slot})
+                if req.blocked_since is not None:
+                    self._tracer.add_span(
+                        "reservation_wait", cat="serve", tid=req.rid,
+                        start=req.blocked_since, end=prefill_start,
+                        args={"request_id": req.rid,
+                              "reserved_blocks": req.reserved_blocks})
             self._ensure_blocks(req, len(req.prompt))
             tok_dev, self._cache = self.engine.prefill_into_slots(
                 self._cache, req.prompt[None, :], [req.slot],
@@ -453,15 +520,25 @@ class ContinuousScheduler:
             req.first_token_at = time.monotonic()
             req.tokens.append(tok)
             self._last_tok[req.slot, 0] = tok
+            if self._tracer.enabled:
+                self._tracer.add_span(
+                    "prefill", cat="serve", tid=req.rid,
+                    start=prefill_start, end=req.first_token_at,
+                    args={"request_id": req.rid, "slot": req.slot,
+                          "prompt_len": int(len(req.prompt))})
             with self._lock:
                 self._admitted += 1
                 self._active[req.slot] = req
+                self._queue_wait_ms.append(queue_wait_s * 1000.0)
+                self._obs["admissions"].inc()
+                self._obs["queue_wait"].observe(queue_wait_s)
+                self._obs["ttft"].observe(req.first_token_at - req.submitted)
+                self._obs["active_slots"].set(len(self._active))
             logger.debug("admitted request into slot %d (prompt %d, ttft "
                          "%.1fms)", req.slot, len(req.prompt),
                          (req.first_token_at - req.submitted) * 1e3)
             if req.done():  # max_new_tokens == 1 or instant eos
                 self._retire(req)
-        del now
 
     def _decode_once(self) -> None:
         """One iteration: a (num_slots, 1) step over all slots, then
@@ -470,6 +547,7 @@ class ContinuousScheduler:
             active_slots = list(self._active)
         if not active_slots:
             return
+        iter_start = time.monotonic()
         active = np.zeros((self.num_slots,), bool)
         active[active_slots] = True
         for slot in active_slots:
@@ -486,6 +564,11 @@ class ContinuousScheduler:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
             self._last_occupancy = len(active_slots)
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "iteration", cat="serve", tid=0,
+                start=iter_start, end=time.monotonic(),
+                args={"active_slots": len(active_slots)})
         for slot in active_slots:
             req = self._active[slot]
             tok = int(toks[slot])
@@ -501,6 +584,16 @@ class ContinuousScheduler:
 
     def _retire(self, req: _SlotRequest) -> None:
         req.finished_at = time.monotonic()
+        if self._tracer.enabled:
+            if req.first_token_at is not None:
+                self._tracer.add_span(
+                    "decode", cat="serve", tid=req.rid,
+                    start=req.first_token_at, end=req.finished_at,
+                    args={"request_id": req.rid, "slot": req.slot,
+                          "tokens": int(len(req.tokens))})
+            self._tracer.add_instant(
+                "retire", cat="serve", tid=req.rid,
+                args={"request_id": req.rid, "slot": req.slot})
         if self.paged is not None:
             # Bulk-free the slot's blocks and point its table row back at
             # trash block 0 BEFORE the slot can go inactive — the shared
@@ -524,6 +617,10 @@ class ContinuousScheduler:
             self._free.append(req.slot)
             self._retired += 1
             self._completed += 1
+            self._obs["retirements"].inc()
+            self._obs["completed"].inc()
+            self._obs["active_slots"].set(len(self._active))
+            self._obs["request"].observe(req.finished_at - req.submitted)
             self._latencies_ms.append(
                 (req.finished_at - req.submitted) * 1e3)
             if req.first_token_at is not None:
@@ -532,5 +629,8 @@ class ContinuousScheduler:
                 if len(req.tokens) > 1:
                     self._tpot_ms.append(
                         (req.finished_at - req.first_token_at) * 1e3
+                        / (len(req.tokens) - 1))
+                    self._obs["tpot"].observe(
+                        (req.finished_at - req.first_token_at)
                         / (len(req.tokens) - 1))
         req.future.set_result(np.asarray(req.tokens, np.int32))

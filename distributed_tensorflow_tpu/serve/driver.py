@@ -95,6 +95,12 @@ class ServeArgs:
     tensor: int = 1
     log_every: int = 16
     seed: int = 0
+    # observability: 0 = no scrape endpoint; >0 binds a Prometheus
+    # /metrics HTTP server on that port for the run's lifetime.
+    metrics_port: int = 0
+    # "" = tracing off; a path enables the flight recorder and writes the
+    # Chrome trace-event JSON (Perfetto-loadable) there at shutdown.
+    trace_out: str = ""
 
 
 def _auto_preset(args: ServeArgs) -> Optional[str]:
@@ -174,9 +180,26 @@ def run_serve(args: ServeArgs,
         engine = ServeEngine(
             args.model, mesh=mesh, checkpoint_dir=args.checkpoint_dir,
             seed=args.seed, **overrides)
+    server = None
+    if args.metrics_port:
+        from distributed_tensorflow_tpu.obs.exporters import MetricsServer
+
+        server = MetricsServer(port=args.metrics_port)
+    if args.trace_out:
+        from distributed_tensorflow_tpu.obs.trace import default_tracer
+
+        default_tracer().enable()
     try:
         return _drive(args, engine)
     finally:
+        if args.trace_out:
+            from distributed_tensorflow_tpu.obs.exporters import (
+                write_chrome_trace,
+            )
+
+            write_chrome_trace(args.trace_out)
+        if server is not None:
+            server.close()
         if own_engine:
             engine.close()
 
@@ -305,6 +328,8 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         "elapsed_s": round(elapsed, 4),
         "p50_latency_ms": round(stats["p50_latency_ms"], 3),
         "p99_latency_ms": round(stats["p99_latency_ms"], 3),
+        "queue_wait_p50_ms": round(stats.get("queue_wait_p50_ms", 0.0), 3),
+        "queue_wait_p99_ms": round(stats.get("queue_wait_p99_ms", 0.0), 3),
         "checkpoint_step": engine.restored_step,
     }
     if is_lm and args.continuous:

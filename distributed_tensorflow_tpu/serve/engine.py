@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -33,6 +34,7 @@ import numpy as np
 from distributed_tensorflow_tpu import cluster as cluster_lib
 from distributed_tensorflow_tpu.checkpoint import CheckpointManager
 from distributed_tensorflow_tpu.models import Workload, get_workload
+from distributed_tensorflow_tpu.obs import metrics as obs_metrics
 from distributed_tensorflow_tpu.parallel.sharding import (
     apply_shardings,
     batch_sharding,
@@ -40,6 +42,27 @@ from distributed_tensorflow_tpu.parallel.sharding import (
 
 logger = logging.getLogger(__name__)
 PyTree = Any
+
+
+def _engine_instruments(registry=None):
+    """Engine-side families: one compile-event counter per program kind
+    (a burst after warmup is normal; compiles during steady-state serving
+    are the shape-bucketing bug the label surfaces), and host-side
+    dispatch timing for the slot programs.  Instrumentation is entirely
+    host-side — it never enters the jitted programs, so the greedy decode
+    programs stay bit-identical."""
+    r = registry or obs_metrics.default_registry()
+    return {
+        "compiles": r.counter(
+            "dtt_serve_compile_events_total",
+            "Program-cache misses by program kind", labelnames=("kind",)),
+        "prefill": r.histogram(
+            "dtt_serve_prefill_seconds",
+            "Host-side slot-prefill dispatch duration"),
+        "decode_step": r.histogram(
+            "dtt_serve_decode_step_seconds",
+            "Host-side slot-decode dispatch duration"),
+    }
 
 
 def _select_next(logits: jax.Array, rng, counter, temperature: float,
@@ -110,6 +133,7 @@ class ServeEngine:
         self._manager: Optional[CheckpointManager] = None
         self._generate_fns: Dict[Any, Callable] = {}
         self._cache_init_fns: Dict[Any, Callable] = {}
+        self._obs = _engine_instruments()
         self.restored_step: Optional[int] = None
         # Base sampling key (in-step RNG: folded with a step counter inside
         # the compiled step, never split on the host per token).
@@ -187,11 +211,13 @@ class ServeEngine:
         arguments), so the default path stays bit-identical."""
         if temperature <= 0.0:
             if "step" not in self._generate_fns:
+                self._obs["compiles"].labels(kind="decode_step").inc()
                 self._generate_fns["step"] = jax.jit(
                     self._decode_apply, donate_argnums=(1,))
             return self._generate_fns["step"]
         key = ("step", float(temperature), int(top_k))
         if key not in self._generate_fns:
+            self._obs["compiles"].labels(kind="decode_step").inc()
             self._generate_fns[key] = jax.jit(
                 functools.partial(self._sampled_decode_apply,
                                   float(temperature), int(top_k)),
@@ -205,6 +231,8 @@ class ServeEngine:
 
         key = (batch, total_len)
         if key not in self._cache_init_fns:
+            self._obs["compiles"].labels(kind="cache_init").inc()
+
             def mk():
                 vs = self.module.init(
                     jax.random.key(0),
@@ -242,6 +270,8 @@ class ServeEngine:
                 f"{cfg.n_positions}")
         key = ("slots", num_slots, total_len)
         if key not in self._cache_init_fns:
+            self._obs["compiles"].labels(kind="slot_cache_init").inc()
+
             def mk():
                 vs = self.module.init(
                     jax.random.key(0),
@@ -293,6 +323,8 @@ class ServeEngine:
 
         key = ("paged", num_slots, total_len, paged)
         if key not in self._cache_init_fns:
+            self._obs["compiles"].labels(kind="paged_cache_init").inc()
+
             def mk():
                 vs = self.module.init(
                     jax.random.key(0),
@@ -375,6 +407,7 @@ class ServeEngine:
             raise ValueError("paged and block_tables go together")
         key = ("slot_prefill", float(temperature), int(top_k), paged)
         if key not in self._generate_fns:
+            self._obs["compiles"].labels(kind="slot_prefill").inc()
             self._generate_fns[key] = jax.jit(
                 functools.partial(self._prefill_slots_apply,
                                   float(temperature), int(top_k), paged),
@@ -382,9 +415,12 @@ class ServeEngine:
         base = rng if rng is not None else self._sample_rng
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
-        return self._generate_fns[key](
+        t0 = time.perf_counter()
+        out = self._generate_fns[key](
             self.params, cache, prompts,
             np.asarray(slot_ids, np.int32), bt, base, counter)
+        self._obs["prefill"].observe(time.perf_counter() - t0)
+        return out
 
     def _decode_slots_apply(self, temperature, top_k, paged, params, cache,
                             tokens, active, block_tables, rng, counter):
@@ -430,6 +466,7 @@ class ServeEngine:
             raise ValueError("paged and block_tables go together")
         key = ("slot_decode", float(temperature), int(top_k), paged)
         if key not in self._generate_fns:
+            self._obs["compiles"].labels(kind="slot_decode").inc()
             self._generate_fns[key] = jax.jit(
                 functools.partial(self._decode_slots_apply,
                                   float(temperature), int(top_k), paged),
@@ -439,9 +476,12 @@ class ServeEngine:
             np.asarray(last_tokens, np.int32), batch_sharding(self.mesh))
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
-        return self._generate_fns[key](
+        t0 = time.perf_counter()
+        out = self._generate_fns[key](
             self.params, cache, tokens_dev,
             np.asarray(active, bool), bt, base, counter)
+        self._obs["decode_step"].observe(time.perf_counter() - t0)
+        return out
 
     def generate(self, prompts: np.ndarray, max_new_tokens: int, *,
                  eos_token: Optional[int] = None, eos_check_every: int = 8,

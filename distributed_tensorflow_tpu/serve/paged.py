@@ -25,7 +25,25 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+
 TRASH_BLOCK = 0
+
+
+def _block_instruments(registry=None):
+    r = registry or obs_metrics.default_registry()
+    return {
+        "in_use": r.gauge(
+            "dtt_kv_blocks_in_use", "Physical KV blocks allocated"),
+        "free": r.gauge(
+            "dtt_kv_blocks_free", "Physical KV blocks on the free list"),
+        "high_water": r.gauge(
+            "dtt_kv_blocks_high_water", "Peak blocks ever in use"),
+        "allocs": r.counter(
+            "dtt_kv_blocks_alloc_total", "Blocks handed out"),
+        "frees": r.counter(
+            "dtt_kv_blocks_freed_total", "Blocks returned"),
+    }
 
 
 class BlockExhaustedError(RuntimeError):
@@ -57,6 +75,13 @@ class BlockAllocator:
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._owner: Dict[int, int] = {}  # block id -> slot id (debugging)
         self.high_water = 0
+        self._obs = _block_instruments()
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        self._obs["in_use"].set(self.used_count)
+        self._obs["free"].set(self.free_count)
+        self._obs["high_water"].set(self.high_water)
 
     @property
     def capacity(self) -> int:
@@ -87,6 +112,8 @@ class BlockAllocator:
         for b in blocks:
             self._owner[b] = slot
         self.high_water = max(self.high_water, self.used_count)
+        self._obs["allocs"].inc(n)
+        self._publish_gauges()
         return blocks
 
     def free(self, blocks: List[int]) -> None:
@@ -101,6 +128,8 @@ class BlockAllocator:
             self._free.append(b)
         if len(self._free) > self.capacity:
             raise AssertionError("freed more blocks than exist")
+        self._obs["frees"].inc(len(blocks))
+        self._publish_gauges()
 
     def stats(self) -> Dict[str, float]:
         return {

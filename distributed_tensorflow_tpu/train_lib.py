@@ -91,6 +91,12 @@ class TrainArgs:
     tensorboard_dir: Optional[str] = None
     metrics_file: Optional[str] = None
     seed: int = 0
+    # observability: 0 = no Prometheus scrape endpoint; >0 binds /metrics
+    # on that port for the run's lifetime.
+    metrics_port: int = 0
+    # None = tracing off; a path enables the flight recorder and writes
+    # Chrome trace-event JSON (Perfetto-loadable) there at teardown.
+    trace_out: Optional[str] = None
 
 
 def parse_args(argv=None) -> TrainArgs:
@@ -161,6 +167,14 @@ def parse_args(argv=None) -> TrainArgs:
     p.add_argument("--tensorboard_dir", type=str, default=None)
     p.add_argument("--metrics_file", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics_port", type=int, default=0,
+                   help="serve a Prometheus /metrics scrape endpoint "
+                        "(step-time histogram, flush counters) on this "
+                        "port for the run's lifetime (0 = off)")
+    p.add_argument("--trace_out", type=str, default=None,
+                   help="write Chrome trace-event JSON (checkpoint "
+                        "save/restore spans; load in Perfetto) here at "
+                        "teardown (unset = tracing off)")
     ns = p.parse_args(argv)
     return TrainArgs(**vars(ns))
 
@@ -502,6 +516,15 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         ))
 
     # 6. Loop.
+    metrics_server = None
+    if args.metrics_port:
+        from distributed_tensorflow_tpu.obs import MetricsServer
+
+        metrics_server = MetricsServer(port=args.metrics_port)
+    if args.trace_out:
+        from distributed_tensorflow_tpu.obs import default_tracer
+
+        default_tracer().enable()
     loop = TrainLoop(
         train_step,
         state,
@@ -527,6 +550,12 @@ def run(args: TrainArgs) -> Dict[str, Any]:
         set_stream_shard_override(None)
         if manager is not None:
             manager.close()
+        if args.trace_out:
+            from distributed_tensorflow_tpu.obs import write_chrome_trace
+
+            write_chrome_trace(args.trace_out)
+        if metrics_server is not None:
+            metrics_server.close()
         server.shutdown()
 
     result = {

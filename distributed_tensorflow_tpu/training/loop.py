@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import math
 import sys
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
@@ -262,6 +263,21 @@ class TrainLoop:
         # (step, device metrics pytree) whose host copy is in flight.
         self._pending_metrics: Optional[tuple] = None
         self._stop = False
+        # Lazy import: obs.__init__ pulls in the hook modules, which import
+        # THIS module — importing obs.metrics at the top here would re-enter
+        # the partially-initialized obs package whenever training.loop is
+        # imported first.
+        from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.default_registry()
+        self._obs_step_time = reg.histogram(
+            "dtt_train_step_seconds",
+            "Host-side dispatch duration of one train step")
+        self._obs_steps = reg.counter(
+            "dtt_train_steps_total", "Train steps dispatched")
+        self._obs_flushes = reg.counter(
+            "dtt_train_metrics_flush_total",
+            "Deferred-metrics fetches consumed on the host")
 
     def request_stop(self) -> None:
         self._stop = True
@@ -295,6 +311,7 @@ class TrainLoop:
         self._pending_metrics = None
         host_tree = jax.device_get(tree)
         host = {k: float(np.asarray(v)) for k, v in host_tree.items()}
+        self._obs_flushes.inc()
         return step, host
 
     def _deliver(self, metrics_step: int, host: Dict[str, float]) -> None:
@@ -345,7 +362,10 @@ class TrainLoop:
             self.request_stop()
             self.last_step_metrics = None
             return completed_steps
+        t0 = time.perf_counter()
         self.state, metrics = fn(self.state, batch, self._step_rng(fn))
+        self._obs_step_time.observe(time.perf_counter() - t0)
+        self._obs_steps.inc()
         completed_steps += 1
         host_metrics = None
         if completed_steps % self.metrics_every == 0:

@@ -14,6 +14,8 @@ Examples:
         --prompt_lens=8,16,24 --min_new_tokens=4             # continuous batching
     python serve.py --model=gpt2 --continuous --cache_mode=paged \
         --block_size=16 --kv_dtype=int8                      # paged + int8 KV
+    python serve.py --model=gpt2 --continuous --metrics_port=9100 \
+        --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
 """
 
 import argparse
@@ -98,6 +100,13 @@ def parse_args(argv=None):
                        help=f"mesh size of the {axis!r} axis")
     p.add_argument("--log_every", type=int, default=defaults.log_every)
     p.add_argument("--seed", type=int, default=defaults.seed)
+    p.add_argument("--metrics_port", type=int, default=defaults.metrics_port,
+                   help="serve a Prometheus /metrics scrape endpoint on "
+                        "this port for the run's lifetime (0 = off)")
+    p.add_argument("--trace_out", default=defaults.trace_out,
+                   help="write a Chrome trace-event JSON (per-request "
+                        "queue/prefill/decode spans; load in Perfetto) "
+                        "here at shutdown ('' = tracing off)")
     return ServeArgs(**vars(p.parse_args(argv)))
 
 

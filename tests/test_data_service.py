@@ -525,6 +525,90 @@ class TestDispatcherDurability:
             for w in workers:
                 w.stop()
 
+    def test_worker_expires_without_heartbeat(self, tmp_path):
+        """expire_after_s: a silent worker drops off the served list while
+        a heartbeating one stays, and the journal compacts to the live
+        set.  Metadata plane only — no data servers needed."""
+        import time
+
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            list_workers,
+            register_worker,
+        )
+
+        journal = str(tmp_path / "registry.journal")
+        disp = DataServiceDispatcher(
+            journal_path=journal, expire_after_s=0.6).start()
+        try:
+            register_worker(disp.target, "10.0.0.1:111")  # will go silent
+            register_worker(disp.target, "10.0.0.2:222")  # will heartbeat
+            assert sorted(list_workers(disp.target)) == [
+                "10.0.0.1:111", "10.0.0.2:222"]
+            # Heartbeat .2 past the window's midpoint so only :222 survives.
+            for _ in range(4):
+                time.sleep(0.2)
+                register_worker(disp.target, "10.0.0.2:222")
+            assert list_workers(disp.target) == ["10.0.0.2:222"]
+            # The journal compacted to the live set (one line, timestamped).
+            lines = [l.split() for l in open(journal) if l.strip()]
+            assert [l[1] for l in lines] == ["10.0.0.2:222"]
+            assert len(lines[0]) == 3
+        finally:
+            disp.stop()
+
+    def test_stale_journal_entries_dropped_on_replay(self, tmp_path):
+        """Replay prunes registrations older than the expiry window;
+        legacy two-field lines (no timestamp) replay as fresh."""
+        import time
+
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+        )
+
+        journal = str(tmp_path / "registry.journal")
+        with open(journal, "w") as f:
+            f.write(f"R 10.0.0.1:111 {time.time() - 3600:.3f}\n")  # stale
+            f.write(f"R 10.0.0.2:222 {time.time():.3f}\n")         # fresh
+            f.write("R 10.0.0.3:333\n")                            # legacy
+        disp = DataServiceDispatcher(
+            journal_path=journal, expire_after_s=60.0)
+        assert sorted(disp.workers) == ["10.0.0.2:222", "10.0.0.3:333"]
+        # Compacted: the stale line is gone from disk too.
+        assert "10.0.0.1:111" not in open(journal).read()
+        # Without expiry the same journal replays everything (legacy
+        # behavior preserved when the feature is off).
+        disp_all = DataServiceDispatcher(journal_path=journal)
+        assert len(disp_all.workers) == 2  # the compacted live set
+        disp.stop()
+        disp_all.stop()
+
+    def test_registration_heartbeat_keeps_worker_alive(self):
+        """The existing heartbeat doubles as the liveness signal: a worker
+        beating faster than the window survives many windows."""
+        import time
+
+        from distributed_tensorflow_tpu.data.dispatcher import (
+            DataServiceDispatcher,
+            list_workers,
+            register_worker,
+            start_registration_heartbeat,
+        )
+
+        disp = DataServiceDispatcher(expire_after_s=0.5).start()
+        beat = None
+        try:
+            register_worker(disp.target, "10.0.0.9:999")
+            beat = start_registration_heartbeat(
+                disp.target, "10.0.0.9:999", interval_s=0.1)
+            for _ in range(4):  # 4 x 0.3s = several expiry windows
+                time.sleep(0.3)
+                assert list_workers(disp.target) == ["10.0.0.9:999"]
+        finally:
+            if beat is not None:
+                beat.set()
+            disp.stop()
+
     def test_heartbeat_recovers_journalless_restart(self, indexed_record):
         import time
 

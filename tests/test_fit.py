@@ -125,6 +125,50 @@ class TestFit:
         np.testing.assert_array_equal(w, w2)
         assert int(jax.device_get(other.state.step)) == 5
 
+    def test_fit_after_load_weights_keeps_restored_opt_state(self, tmp_path):
+        """Resume parity: fit() after load_weights() of a mid-training
+        checkpoint must carry the restored optimizer state through the
+        real-horizon rebuild — a fresh opt_state would silently reset
+        Adam's moments and the schedule position.  Every scalar count in
+        adamw's opt_state tracks the step, so after 4 + 4 steps they all
+        read 8 (a reset would leave them at 4)."""
+        model = Model("mnist", batch_size=32)
+        model.fit(epochs=1, steps_per_epoch=4)
+        model.save_weights(str(tmp_path / "w"))
+
+        resumed = Model("mnist", batch_size=32)
+        resumed.load_weights(str(tmp_path / "w"))
+        resumed.fit(epochs=1, steps_per_epoch=4)
+        assert int(jax.device_get(resumed.state.step)) == 8
+        counts = [int(jax.device_get(leaf))
+                  for leaf in jax.tree.leaves(resumed.state.opt_state)
+                  if np.asarray(jax.device_get(leaf)).ndim == 0]
+        assert counts, "adamw opt_state should carry scalar step counts"
+        assert all(c == 8 for c in counts), counts
+
+    def test_multihost_global_batched_dataset_fails_loudly(self, monkeypatch):
+        """On >1 hosts a pre-built (usually GLOBAL-batched) dataset whose
+        first batch doesn't match the per-host size must raise — pointing
+        at data.tf_dataset_data_fn — not warn and desync."""
+        model = Model("mnist", batch_size=32)
+
+        class FakeDataset:
+            """Duck-typed tf.data.Dataset yielding GLOBAL batches of 64."""
+
+            def shard(self, num_shards, index):
+                return self
+
+            def as_numpy_iterator(self):
+                rng = np.random.RandomState(0)
+                while True:
+                    yield {"image": rng.rand(64, 28, 28, 1)
+                           .astype(np.float32),
+                           "label": np.zeros((64,), np.int32)}
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="tf_dataset_data_fn"):
+            next(model._host_iter(FakeDataset()))
+
     def test_fit_call_ports_intact_from_tf_dataset(self):
         """The migration story: a reference TF2 script's dataset feeds
         fit() unchanged through the tf.data adapter."""

@@ -1,14 +1,21 @@
-"""Observability tests: TensorBoard/JSONL writers and profiler wrappers."""
+"""Observability tests: metrics registry, exporters, tracing, writers."""
 
 import json
+import logging
 import os
+import threading
+import urllib.request
 
 import pytest
 
 from distributed_tensorflow_tpu.obs import (
     MetricsFileWriter,
+    MetricsServer,
     Profile,
+    Registry,
     TensorBoardHook,
+    Tracer,
+    render_prometheus,
 )
 from distributed_tensorflow_tpu.training import FP32, TrainLoop, make_train_step
 from tests.test_training import linear_batch, make_linear_state, quadratic_loss
@@ -101,3 +108,300 @@ class TestProfile:
             found += [f for f in files if f.endswith((".pb", ".json.gz",
                                                       ".xplane.pb"))]
         assert found, f"no trace artifacts under {d}"
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_returns_same_family(self):
+        r = Registry()
+        c1 = r.counter("dtt_x_total", "help")
+        c2 = r.counter("dtt_x_total")
+        assert c1 is c2
+        c1.inc(3)
+        assert c2.value == 3
+
+    def test_type_conflict_raises(self):
+        r = Registry()
+        r.counter("dtt_x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("dtt_x_total")
+
+    def test_labelnames_conflict_raises(self):
+        r = Registry()
+        r.counter("dtt_x_total", labelnames=("kind",))
+        with pytest.raises(ValueError, match="labels"):
+            r.counter("dtt_x_total", labelnames=("other",))
+
+    def test_counter_rejects_negative(self):
+        r = Registry()
+        with pytest.raises(ValueError, match="only go up"):
+            r.counter("dtt_x_total").inc(-1)
+
+    def test_labels_key_children_independently(self):
+        r = Registry()
+        c = r.counter("dtt_compiles_total", labelnames=("kind",))
+        c.labels(kind="prefill").inc()
+        c.labels(kind="decode").inc(2)
+        c.labels(kind="prefill").inc()
+        values = {k: child.value for k, child in c.samples()}
+        assert values == {("decode",): 2, ("prefill",): 2}
+        # A labeled family refuses unlabeled use.
+        with pytest.raises(ValueError, match="use .labels"):
+            c.inc()
+
+    def test_gauge_set_inc_dec(self):
+        r = Registry()
+        g = r.gauge("dtt_depth")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value == 4
+
+    def test_histogram_quantiles_interpolate(self):
+        r = Registry()
+        h = r.histogram("dtt_lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.6, 5.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.sum == pytest.approx(6.15)
+        # p50 lands in the (0.1, 1.0] bucket, interpolated.
+        assert 0.1 < h.quantile(0.5) <= 1.0
+        # The +Inf bucket reports its finite lower edge.
+        h.observe(99.0)
+        assert h.quantile(1.0) == 10.0
+
+    def test_thread_safety_smoke(self):
+        r = Registry()
+        c = r.counter("dtt_races_total")
+        h = r.histogram("dtt_race_seconds", buckets=(0.5,))
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+        assert h.count == 8000
+
+    def test_stats_provider_bridge_uniquifies(self):
+        r = Registry()
+        ns1 = r.register_stats("serve/x", lambda: {"a": 1})
+        ns2 = r.register_stats("serve/x", lambda: {"a": 2})
+        assert ns1 == "serve/x" and ns2 == "serve/x-2"
+        assert r.stats(ns2) == {"a": 2}
+        r.unregister_stats(ns1)
+        assert r.stats(ns1) is None
+
+
+class TestPrometheusRendering:
+    def test_text_format(self):
+        r = Registry()
+        r.counter("dtt_req_total", "requests").inc(3)
+        r.gauge("dtt_depth", "queue depth", labelnames=("pool",)) \
+            .labels(pool="a").set(2)
+        h = r.histogram("dtt_lat_seconds", "latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 3.0):
+            h.observe(v)
+        text = render_prometheus(r)
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert "# TYPE dtt_req_total counter" in lines
+        assert "dtt_req_total 3" in lines
+        assert "# HELP dtt_depth queue depth" in lines
+        assert 'dtt_depth{pool="a"} 2' in lines
+        # Histogram: cumulative buckets + sum + count.
+        assert 'dtt_lat_seconds_bucket{le="0.1"} 1' in lines
+        assert 'dtt_lat_seconds_bucket{le="1"} 2' in lines
+        assert 'dtt_lat_seconds_bucket{le="+Inf"} 3' in lines
+        assert "dtt_lat_seconds_sum 3.55" in lines
+        assert "dtt_lat_seconds_count 3" in lines
+
+    def test_scrape_endpoint_round_trip(self):
+        r = Registry()
+        r.counter("dtt_scraped_total").inc()
+        with MetricsServer(port=0, registry=r, host="127.0.0.1") as srv:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+        assert "dtt_scraped_total 1" in body
+
+
+# -- tracing ------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_disabled_tracer_records_nothing(self):
+        t = Tracer()
+        t.add_span("x", start=0.0, end=1.0)
+        t.add_instant("y")
+        assert len(t) == 0
+
+    def test_ring_buffer_bounds_memory(self):
+        t = Tracer(capacity=4, enabled=True)
+        for i in range(10):
+            t.add_span(f"s{i}", start=float(i), end=float(i) + 0.5)
+        assert len(t) == 4
+        assert [e["name"] for e in t.events()] == ["s6", "s7", "s8", "s9"]
+
+    def test_chrome_trace_schema(self, tmp_path):
+        t = Tracer(enabled=True)
+        with t.span("prefill", cat="serve", tid=7, args={"rid": 7}):
+            pass
+        t.add_instant("retire", cat="serve", tid=7)
+        path = str(tmp_path / "trace.json")
+        assert t.write(path) == 2
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        # Metadata event first, then the recorded events.
+        assert evs[0]["ph"] == "M" and evs[0]["name"] == "process_name"
+        span = next(e for e in evs if e["name"] == "prefill")
+        assert span["ph"] == "X" and span["tid"] == 7
+        assert isinstance(span["ts"], int) and isinstance(span["dur"], int)
+        assert span["args"] == {"rid": 7}
+        instant = next(e for e in evs if e["name"] == "retire")
+        assert instant["ph"] == "i"
+
+
+# -- monitor hooks as thin registry readers ----------------------------------
+
+
+FIXED_STATS = {
+    "queue_depth": 3, "capacity": 64, "completed": 10, "rejected": 1,
+    "batches": 4, "avg_batch_occupancy": 2.5,
+    "p50_latency_ms": 12.0, "p99_latency_ms": 40.0,
+}
+
+CONTINUOUS_STATS = {
+    "queue_depth": 2, "capacity": 64, "completed": 9, "rejected": 0,
+    "iterations": 30, "active_slots": 4, "num_slots": 8,
+    "slot_occupancy": 0.5, "admissions_per_iter": 0.3,
+    "retirements_per_iter": 0.3, "ttft_p50_ms": 20.0, "ttft_p99_ms": 50.0,
+    "tpot_mean_ms": 1.5, "p50_latency_ms": 30.0, "p99_latency_ms": 80.0,
+}
+
+
+class TestHookLogCompat:
+    """The refactor to registry readers must not change one log byte."""
+
+    def _log_line(self, caplog, stats):
+        from distributed_tensorflow_tpu.obs import serve as obs_serve
+
+        r = Registry()
+        ns = r.register_stats("serve/test", lambda: dict(stats))
+        hook = obs_serve.ServeMonitorHook(ns, registry=r)
+        with caplog.at_level(logging.INFO, logger=obs_serve.__name__):
+            m = hook.log(100)
+        assert m["serve_completed"] == stats["completed"]
+        return caplog.records[-1].getMessage()
+
+    def test_fixed_mode_line_unchanged(self, caplog):
+        assert self._log_line(caplog, FIXED_STATS) == (
+            "serve @ 100: depth=3/64 done=10 rej=1 batches=4 "
+            "occupancy=2.50 p50=12.0ms p99=40.0ms")
+
+    def test_continuous_mode_line_unchanged(self, caplog):
+        assert self._log_line(caplog, CONTINUOUS_STATS) == (
+            "serve @ 100: depth=2/64 done=9 rej=0 iters=30 slots=4/8 "
+            "occupancy=0.50 adm/it=0.30 ret/it=0.30 ttft_p50=20.0ms "
+            "ttft_p99=50.0ms tpot=1.50ms p50=30.0ms p99=80.0ms")
+
+    def test_prefetch_line_unchanged(self, caplog):
+        from distributed_tensorflow_tpu.obs import prefetch as obs_prefetch
+
+        r = Registry()
+        ns = r.register_stats("prefetch", lambda: {
+            "queue_depth": 2, "capacity": 2, "enqueued": 50, "dequeued": 48,
+            "producer_wait_s": 0.125, "consumer_wait_s": 0.5,
+        })
+        hook = obs_prefetch.PrefetchMonitorHook(ns, every_steps=1, registry=r)
+
+        class FakeLoop:
+            last_logged_metrics = {}
+
+        with caplog.at_level(logging.INFO, logger=obs_prefetch.__name__):
+            hook.after_step(FakeLoop(), 100, {})
+        assert caplog.records[-1].getMessage() == (
+            "prefetch @ step 100: depth=2/2 in=50 out=48 "
+            "producer_wait=0.125s consumer_wait=0.500s")
+
+    def test_hook_resolves_component_via_registry_namespace(self):
+        """Passing the component resolves the provider registered under its
+        obs_namespace — the hook never calls a private stats path."""
+        from distributed_tensorflow_tpu.obs.serve import ServeMonitorHook
+
+        r = Registry()
+
+        class FakeBatcher:
+            obs_namespace = None
+
+            def stats(self):  # the legacy escape hatch, NOT used here
+                raise AssertionError("hook must read the registry provider")
+
+        b = FakeBatcher()
+        b.obs_namespace = r.register_stats(
+            "serve/fake", lambda: dict(FIXED_STATS))
+        hook = ServeMonitorHook(b, registry=r)
+        assert hook.metrics()["serve_queue_depth"] == 3
+
+
+class TestInstrumentedComponents:
+    def test_train_loop_publishes_step_metrics(self):
+        from distributed_tensorflow_tpu.obs import default_registry
+
+        r = default_registry()
+        steps = r.counter("dtt_train_steps_total")
+        before = steps.value
+        run_loop([], steps=6)
+        assert steps.value == before + 6
+        assert r.histogram("dtt_train_step_seconds").count >= 6
+
+    def test_checkpoint_save_restore_metrics_and_spans(self, tmp_path):
+        import jax
+
+        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+        from distributed_tensorflow_tpu.obs import (default_registry,
+                                                    default_tracer)
+
+        tracer = default_tracer()
+        was_enabled = tracer.enabled
+        tracer.enable()
+        r = default_registry()
+        saves = r.histogram("dtt_checkpoint_save_seconds")
+        n0 = saves.count
+        try:
+            state = {"w": jax.numpy.ones((4,))}
+            with CheckpointManager(str(tmp_path / "ckpt"),
+                                   async_save=False) as mgr:
+                mgr.save(1, state, force=True)
+                mgr.wait_until_finished()
+                restored = mgr.restore(1, template=state)
+            assert saves.count == n0 + 1
+            names = [e["name"] for e in tracer.events()]
+            assert "checkpoint_save" in names
+            assert "checkpoint_restore" in names
+        finally:
+            if not was_enabled:
+                tracer.disable()
+        assert float(restored["w"][0]) == 1.0
+
+    def test_jsonl_metrics_writer(self, tmp_path):
+        from distributed_tensorflow_tpu.obs import JsonlMetricsWriter
+
+        r = Registry()
+        r.counter("dtt_j_total").inc(2)
+        r.histogram("dtt_j_seconds", buckets=(1.0,)).observe(0.5)
+        p = str(tmp_path / "obs.jsonl")
+        w = JsonlMetricsWriter(p, registry=r)
+        w.write(step=7)
+        w.close()
+        rec = json.loads(open(p).read().splitlines()[0])
+        assert rec["step"] == 7
+        assert rec["dtt_j_total"] == 2
+        assert rec["dtt_j_seconds_count"] == 1

@@ -98,13 +98,18 @@ def test_bench_serve_mode_prints_one_json_line():
                 "paged_tokens_per_sec", "paged_speedup",
                 "paged_int8_tokens_per_sec", "kv_hbm_bytes",
                 "kv_hbm_ratio_paged", "kv_hbm_ratio_paged_int8",
-                "block_size", "num_blocks", "block_utilization"):
+                "block_size", "num_blocks", "block_utilization",
+                "queue_wait_p50_ms", "queue_wait_p99_ms", "trace_events"):
         assert key in out, f"missing {key!r} in {out}"
     assert out["unit"] == "tokens/sec"
     assert out["value"] > 0
     assert out["fixed_tokens_per_sec"] > 0
     assert out["paged_tokens_per_sec"] > 0
     assert "serve_tokens_per_sec" in out["metric"]
+    # the trace-export smoke: the bench runs with the flight recorder on,
+    # so the continuous runs must have recorded per-request spans
+    assert out["trace_events"] > 0
+    assert out["queue_wait_p99_ms"] >= out["queue_wait_p50_ms"] >= 0
     # the memory claim: paged <= 0.5x dense cache bytes, int8 <= 0.25x
     assert out["kv_hbm_bytes"]["paged"] < out["kv_hbm_bytes"]["dense"]
     assert out["kv_hbm_ratio_paged"] <= 0.5

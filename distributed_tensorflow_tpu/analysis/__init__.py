@@ -1,0 +1,49 @@
+"""dttlint: project-specific static analysis for this codebase.
+
+``python -m distributed_tensorflow_tpu.analysis`` runs six rules over
+the tree and exits non-zero on any non-baselined finding:
+
+- ``jit-purity`` — no host side effects (time/random/logging/print/obs)
+  reachable from ``jax.jit``-compiled functions;
+- ``recompile-hazard`` — jit static args and cache keys must be frozen
+  and hashable; compiled closures must not capture mutable locals;
+- ``lock-discipline`` — attributes written under ``self._lock`` are
+  flagged wherever they're touched outside it;
+- ``layering`` — obs core imports no jax/flax, models/training/data
+  import no serve, no top-level import cycles;
+- ``unused-import`` / ``mutable-default`` — the hygiene pair ruff
+  enforces when installed, enforced here regardless.
+
+This package must stay importable without jax — the layering rule
+checks that about the package itself.
+"""
+
+from distributed_tensorflow_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    render_baseline,
+    split_findings,
+)
+from distributed_tensorflow_tpu.analysis.core import (
+    Finding,
+    Module,
+    Rule,
+    collect_files,
+    load_modules,
+    run_rules,
+)
+from distributed_tensorflow_tpu.analysis.registry import default_rules
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Module",
+    "Rule",
+    "collect_files",
+    "default_rules",
+    "load_baseline",
+    "load_modules",
+    "render_baseline",
+    "run_rules",
+    "split_findings",
+]

@@ -1,0 +1,120 @@
+"""dttlint runner: ``python -m distributed_tensorflow_tpu.analysis``.
+
+Exit codes: 0 = clean (or everything baselined), 1 = non-baselined
+findings, 2 = bad invocation / unparseable baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from distributed_tensorflow_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    BaselineError,
+    load_baseline,
+    render_baseline,
+    split_findings,
+)
+from distributed_tensorflow_tpu.analysis.core import (
+    collect_files,
+    load_modules,
+    run_rules,
+)
+from distributed_tensorflow_tpu.analysis.registry import default_rules
+
+
+def repo_root() -> Path:
+    # analysis/ -> distributed_tensorflow_tpu/ -> repo root
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def default_targets(root: Path) -> List[Path]:
+    targets: List[Path] = [root / "distributed_tensorflow_tpu"]
+    for name in ("train.py", "serve.py", "bench.py"):
+        if (root / name).exists():
+            targets.append(root / name)
+    scripts = root / "scripts"
+    if scripts.is_dir():
+        targets.extend(sorted(scripts.glob("*.py")))
+    return targets
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dttlint",
+        description="project-specific static analysis "
+                    "(jit-purity, recompile-hazard, lock-discipline, "
+                    "layering, hygiene)")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/dirs to analyze (default: whole tree)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                        help="baseline file (default: analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings as a baseline scaffold "
+                             "and exit 0")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule ids to run (default: all)")
+    args = parser.parse_args(argv)
+
+    root = repo_root()
+    paths = args.paths or default_targets(root)
+    files = collect_files(paths, root)
+    modules, errors = load_modules(files, root)
+
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"dttlint: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    findings = errors + run_rules(modules, rules)
+
+    if args.write_baseline:
+        args.baseline.write_text(render_baseline(findings))
+        print(f"dttlint: wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, baselined, stale = list(findings), [], []
+    else:
+        try:
+            entries = load_baseline(args.baseline)
+        except (BaselineError, json.JSONDecodeError) as e:
+            print(f"dttlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        new, baselined, stale = split_findings(findings, entries)
+
+    if args.json:
+        print(json.dumps({
+            "files": len(files),
+            "findings": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in baselined],
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        for e in stale:
+            print(f"dttlint: warning: stale baseline entry "
+                  f"[{e['rule']}] {e['path']}: {e['code']!r}")
+        status = "clean" if not new else f"{len(new)} finding(s)"
+        print(f"dttlint: {len(files)} files, {status}, "
+              f"{len(baselined)} baselined, {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

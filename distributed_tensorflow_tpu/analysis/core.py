@@ -1,0 +1,317 @@
+"""dttlint core: findings, module loading, suppressions, the rule engine.
+
+The framework is deliberately dependency-free (stdlib ``ast`` + ``tokenize``
+only — importing the analyzer must never pull in jax), because its whole
+point is to machine-check invariants that the heavy runtime code can only
+state in comments:
+
+- instrumentation never enters compiled programs (``jit-purity``),
+- jit cache keys stay frozen and hashable (``recompile-hazard``),
+- shared mutable state is touched only under the lock (``lock-discipline``),
+- the layer map holds and stays acyclic (``layering``),
+- plus the hygiene pair ruff would enforce when installed
+  (``unused-import``, ``mutable-default``).
+
+A rule sees the WHOLE analyzed module set (``Rule.run(modules)``), so
+cross-module facts — the import graph, the dataclass registry — are
+first-class.  Findings carry ``path:line``, a rule id, a severity, the
+enclosing symbol, and the stripped source line (``code``) the baseline
+matches on, so baselined findings survive unrelated line-number drift.
+
+Suppression surface (no silent suppressions — the baseline requires a
+justification per entry, see ``analysis.baseline``):
+
+- ``# dttlint: disable=rule1,rule2`` trailing a line suppresses those rules
+  on that line;
+- the same comment on a line of its own suppresses the next code line;
+- ``# dttlint: disable-file=rule1,rule2`` anywhere suppresses the rules for
+  the whole file (``disable=all`` / ``disable-file=all`` cover every rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dttlint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnosed violation, pointing at ``path:line``."""
+
+    rule: str
+    path: str  # repo-root-relative, posix separators
+    line: int
+    message: str
+    severity: str = "error"
+    symbol: str = ""  # enclosing function/class, best effort
+    code: str = ""  # stripped source line — the baseline match key
+
+    def format(self) -> str:
+        sym = f" ({self.symbol})" if self.symbol else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{sym}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def sort_key(self) -> Tuple:
+        return (self.path, self.line, self.rule, self.message)
+
+
+class Module:
+    """A parsed source file plus everything rules repeatedly need."""
+
+    def __init__(self, path: Path, repo_root: Path):
+        self.path = path
+        self.relpath = _relpath(path, repo_root)
+        self.name = _module_name(self.relpath)
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._parse_suppressions()
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # -- suppressions --------------------------------------------------------
+
+    def _parse_suppressions(self) -> None:
+        pending: Set[str] = set()  # comment-only lines apply to the NEXT code
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except tokenize.TokenError:
+            return
+        code_seen: Set[int] = set()  # lines with non-comment tokens
+        for tok in tokens:
+            if tok.type in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT, tokenize.ENDMARKER):
+                continue
+            if tok.type == tokenize.COMMENT:
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind, rules_s = m.groups()
+                rules = {r.strip() for r in rules_s.split(",") if r.strip()}
+                if kind == "disable-file":
+                    self.file_suppressions |= rules
+                elif tok.start[0] in code_seen:  # trailing comment
+                    self.line_suppressions.setdefault(
+                        tok.start[0], set()).update(rules)
+                else:  # standalone comment line: applies to next code line
+                    pending |= rules
+            else:
+                line = tok.start[0]
+                if line not in code_seen:
+                    code_seen.add(line)
+                    if pending:
+                        self.line_suppressions.setdefault(
+                            line, set()).update(pending)
+                        pending = set()
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ruleset in (self.file_suppressions,
+                        self.line_suppressions.get(line, ())):
+            if rule in ruleset or "all" in ruleset:
+                return True
+        return False
+
+    # -- tree helpers --------------------------------------------------------
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def enclosing(self, node: ast.AST, kinds: Tuple[type, ...]
+                  ) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        """Best-effort ``Class.method`` / ``function`` context string."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def code_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def _relpath(path: Path, repo_root: Path) -> str:
+    try:
+        return path.relative_to(repo_root).as_posix()
+    except ValueError:  # e.g. a test fixture under /tmp
+        return path.name
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath.split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    elif parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+# -- shared AST utilities -----------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class ImportRecord:
+    target: str  # canonical imported module (or module.name for from-imports)
+    line: int
+    toplevel: bool
+
+
+class ImportMap:
+    """Alias -> canonical dotted target, plus the raw import list.
+
+    ``import numpy as np`` maps ``np -> numpy``; ``from x.y import z as w``
+    maps ``w -> x.y.z``.  ``canonical("np.random.rand")`` rewrites the alias
+    prefix so rules compare against real module paths.
+    """
+
+    def __init__(self, module: Module):
+        self.aliases: Dict[str, str] = {}
+        self.records: List[ImportRecord] = []
+        body_ids = set(map(id, module.tree.body))
+        for node in ast.walk(module.tree):
+            toplevel = id(node) in body_ids
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.records.append(
+                        ImportRecord(a.name, node.lineno, toplevel))
+                    bound = a.asname or a.name.split(".")[0]
+                    self.aliases[bound] = a.asname and a.name or bound
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative import — not used in this repo
+                    continue
+                for a in node.names:
+                    target = f"{node.module}.{a.name}"
+                    self.records.append(
+                        ImportRecord(target, node.lineno, toplevel))
+                    self.aliases[a.asname or a.name] = target
+
+    def canonical(self, dotted_name: str) -> str:
+        head, sep, rest = dotted_name.partition(".")
+        base = self.aliases.get(head)
+        if base is None:
+            return dotted_name
+        return base + sep + rest if sep else base
+
+
+class Rule:
+    """A rule family: ``run`` sees the whole module set at once."""
+
+    id = "abstract"
+    description = ""
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        raise NotImplementedError
+
+
+# -- engine -------------------------------------------------------------------
+
+DEFAULT_EXCLUDE_DIRS = {"tests", "examples", "__pycache__", ".git"}
+
+
+def collect_files(paths: Iterable[Path], repo_root: Path) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p).resolve()
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                try:
+                    parents = f.relative_to(repo_root).parts[:-1]
+                except ValueError:  # outside the repo (e.g. tmp fixtures)
+                    parents = f.parts[:-1]
+                if any(part in DEFAULT_EXCLUDE_DIRS for part in parents):
+                    continue
+                out.append(f)
+        elif p.suffix == ".py":
+            out.append(p)
+    seen: Set[Path] = set()
+    uniq = []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+def load_modules(files: Sequence[Path], repo_root: Path
+                 ) -> Tuple[List[Module], List[Finding]]:
+    modules, errors = [], []
+    for f in files:
+        try:
+            modules.append(Module(f, repo_root))
+        except SyntaxError as e:
+            errors.append(Finding(
+                rule="parse-error",
+                path=Path(f).relative_to(repo_root).as_posix(),
+                line=e.lineno or 1,
+                message=f"cannot parse: {e.msg}",
+            ))
+    return modules, errors
+
+
+def run_rules(modules: Sequence[Module], rules: Sequence[Rule]
+              ) -> List[Finding]:
+    """Run every rule, drop suppressed findings, attach source lines."""
+    by_path = {m.relpath: m for m in modules}
+    findings: List[Finding] = []
+    for rule in rules:
+        for f in rule.run(modules):
+            mod = by_path.get(f.path)
+            if mod is not None:
+                if mod.suppressed(f.rule, f.line):
+                    continue
+                if not f.code:
+                    f.code = mod.code_at(f.line)
+            findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    # Dedup identical findings (a rule may reach the same line twice).
+    out: List[Finding] = []
+    for f in findings:
+        if not out or out[-1].sort_key() != f.sort_key():
+            out.append(f)
+    return out

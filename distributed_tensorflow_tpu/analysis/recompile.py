@@ -1,0 +1,380 @@
+"""recompile-hazard: jit cache keys must be frozen, hashable, and stable.
+
+The ``PagedKVConfig`` discipline (PR 4) generalized.  jax caches one
+compiled program per (static args, shape/dtype signature); three
+classes of mistakes silently defeat or poison that cache:
+
+- RH1 — ``static_argnums`` / ``static_argnames`` pointing at parameters
+  whose defaults or annotations are unhashable containers (list/dict/
+  set): every call either raises ``TypeError: unhashable`` or, with a
+  converted-but-unstable key, recompiles.
+- RH2 — non-frozen dataclasses used as jit cache keys.  The rule builds
+  a whole-tree dataclass registry (``@dataclasses.dataclass`` without
+  ``frozen=True`` and without ``eq=False``/``__hash__`` is unhashable by
+  construction; ``flax.struct.dataclass`` is frozen) and flags when such
+  a type's instances flow into a compiled-function cache: a
+  ``self._cache[key] = jax.jit(...)`` dict whose key tuple includes a
+  value annotated/constructed as that type, or ``functools.partial``
+  args to jit carrying one.
+- RH3 — closures over mutable state: a jitted nested function or lambda
+  whose free variables are assigned mutable literals (list/dict/set) in
+  the enclosing scope.  The closure is captured BY VALUE at trace time —
+  later mutation never reaches the compiled program, a classic silent
+  staleness bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from distributed_tensorflow_tpu.analysis.core import (
+    Finding,
+    ImportMap,
+    Module,
+    Rule,
+    dotted,
+)
+
+RULE_ID = "recompile-hazard"
+
+_JIT_CALLEES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "pjit"}
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+_UNHASHABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set",
+                           "MutableMapping", "bytearray"}
+
+
+def _is_jit(call: ast.Call, imports: ImportMap) -> bool:
+    name = dotted(call.func)
+    return name is not None and imports.canonical(name) in _JIT_CALLEES
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _annotation_head(ann: Optional[ast.expr]) -> Optional[str]:
+    if ann is None:
+        return None
+    node = ann.value if isinstance(ann, ast.Subscript) else ann
+    name = dotted(node)
+    return name.split(".")[-1] if name else None
+
+
+class _DataclassInfo:
+    def __init__(self, name: str, module: Module, node: ast.ClassDef,
+                 hashable: bool):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.hashable = hashable
+
+
+def _dataclass_registry(modules: Sequence[Module]) -> Dict[str, _DataclassInfo]:
+    """Class name -> hashability, across the whole analyzed tree."""
+    registry: Dict[str, _DataclassInfo] = {}
+    for module in modules:
+        imports = ImportMap(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = frozen = eq_false = False
+            for dec in node.decorator_list:
+                callee = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(callee)
+                canonical = imports.canonical(name) if name else ""
+                if canonical in ("dataclasses.dataclass", "dataclass"):
+                    is_dc = True
+                    if isinstance(dec, ast.Call):
+                        fz = _kw(dec, "frozen")
+                        eq = _kw(dec, "eq")
+                        frozen = (isinstance(fz, ast.Constant)
+                                  and fz.value is True)
+                        eq_false = (isinstance(eq, ast.Constant)
+                                    and eq.value is False)
+                elif canonical in ("flax.struct.dataclass",
+                                   "struct.dataclass"):
+                    is_dc = frozen = True
+            if not is_dc:
+                continue
+            defines_hash = any(
+                isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and i.name == "__hash__" for i in node.body)
+            hashable = frozen or eq_false or defines_hash
+            registry[node.name] = _DataclassInfo(
+                node.name, module, node, hashable)
+    return registry
+
+
+class RecompileHazardRule(Rule):
+    id = RULE_ID
+    description = "jit static args / cache keys that break compilation caching"
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        registry = _dataclass_registry(modules)
+        findings: List[Finding] = []
+        for module in modules:
+            imports = ImportMap(module)
+            findings.extend(self._static_args(module, imports))
+            findings.extend(self._cache_keys(module, imports, registry))
+            findings.extend(self._mutable_closures(module, imports))
+        return findings
+
+    # -- RH1: static_argnums/static_argnames on unhashable params ------------
+
+    def _static_args(self, module: Module, imports: ImportMap
+                     ) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and _is_jit(node, imports)):
+                continue
+            target = self._jit_target_fn(module, node)
+            if target is None:
+                continue
+            params = list(target.args.posonlyargs) + list(target.args.args)
+            defaults = target.args.defaults
+            default_by_param: Dict[str, ast.expr] = {}
+            for param, dflt in zip(params[len(params) - len(defaults):],
+                                   defaults):
+                default_by_param[param.arg] = dflt
+
+            static_params: List[str] = []
+            nums = _kw(node, "static_argnums")
+            if isinstance(nums, (ast.Tuple, ast.List)):
+                for el in nums.elts:
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, int)
+                            and 0 <= el.value < len(params)):
+                        static_params.append(params[el.value].arg)
+            elif isinstance(nums, ast.Constant) and isinstance(nums.value, int):
+                if 0 <= nums.value < len(params):
+                    static_params.append(params[nums.value].arg)
+            names = _kw(node, "static_argnames")
+            if isinstance(names, (ast.Tuple, ast.List)):
+                for el in names.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str):
+                        static_params.append(el.value)
+            elif isinstance(names, ast.Constant) and isinstance(
+                    names.value, str):
+                static_params.append(names.value)
+
+            by_name = {p.arg: p for p in params}
+            for pname in static_params:
+                param = by_name.get(pname)
+                if param is None:
+                    continue
+                dflt = default_by_param.get(pname)
+                ann_head = _annotation_head(param.annotation)
+                if isinstance(dflt, _MUTABLE_LITERALS):
+                    findings.append(Finding(
+                        rule=self.id, path=module.relpath, line=node.lineno,
+                        message=(f"static arg `{pname}` has an unhashable "
+                                 "mutable default — every jit call will "
+                                 "raise or recompile"),
+                        symbol=module.symbol_for(node)))
+                elif ann_head in _UNHASHABLE_ANNOTATIONS:
+                    findings.append(Finding(
+                        rule=self.id, path=module.relpath, line=node.lineno,
+                        message=(f"static arg `{pname}` is annotated "
+                                 f"`{ann_head}` (unhashable) — jit static "
+                                 "args must be hashable"),
+                        symbol=module.symbol_for(node)))
+        return findings
+
+    def _jit_target_fn(self, module: Module, call: ast.Call
+                       ) -> Optional[ast.FunctionDef]:
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Name):
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name == arg.id:
+                    return node
+        return None
+
+    # -- RH2: non-frozen dataclasses as jit cache keys -----------------------
+
+    def _cache_keys(self, module: Module, imports: ImportMap,
+                    registry: Dict[str, _DataclassInfo]) -> List[Finding]:
+        findings: List[Finding] = []
+        unhashable = {n for n, info in registry.items() if not info.hashable}
+        if not unhashable:
+            return findings
+
+        # Map local names annotated/constructed as an unhashable dataclass,
+        # per function scope.
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            typed: Dict[str, str] = {}
+            for arg in list(fn.args.posonlyargs) + list(fn.args.args) + \
+                    list(fn.args.kwonlyargs):
+                head = _annotation_head(arg.annotation)
+                if head in unhashable:
+                    typed[arg.arg] = head
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call):
+                    callee = dotted(node.value.func)
+                    head = callee.split(".")[-1] if callee else None
+                    if head in unhashable:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                typed[t.id] = head
+                elif isinstance(node, ast.AnnAssign) and isinstance(
+                        node.target, ast.Name):
+                    head = _annotation_head(node.annotation)
+                    if head in unhashable:
+                        typed[node.target.id] = head
+            if not typed:
+                continue
+
+            # key tuples: `key = (..., cfg, ...)` later used in
+            # `self._cache[key] = jax.jit(...)`; or direct
+            # `self._cache[(.., cfg, ..)] = jax.jit(...)`.
+            key_tuples: Dict[str, ast.Tuple] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Tuple):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            key_tuples[t.id] = node.value
+
+            def _tuple_hits(tup: ast.Tuple) -> List[str]:
+                hits = []
+                for el in tup.elts:
+                    if isinstance(el, ast.Name) and el.id in typed:
+                        hits.append(f"{el.id}: {typed[el.id]}")
+                return hits
+
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call) and _is_jit(
+                            node.value, imports):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Subscript):
+                            continue
+                        key = t.slice
+                        hits: List[str] = []
+                        if isinstance(key, ast.Tuple):
+                            hits = _tuple_hits(key)
+                        elif isinstance(key, ast.Name):
+                            if key.id in typed:
+                                hits = [f"{key.id}: {typed[key.id]}"]
+                            elif key.id in key_tuples:
+                                hits = _tuple_hits(key_tuples[key.id])
+                        for hit in hits:
+                            findings.append(Finding(
+                                rule=self.id, path=module.relpath,
+                                line=node.lineno,
+                                message=(f"jit cache key includes `{hit}` — "
+                                         "a non-frozen dataclass is "
+                                         "unhashable / mutable as a cache "
+                                         "key (freeze it like "
+                                         "PagedKVConfig)"),
+                                symbol=module.symbol_for(node)))
+                # functools.partial(fn, cfg) handed to jit
+                if isinstance(node, ast.Call) and _is_jit(node, imports) \
+                        and node.args and isinstance(node.args[0], ast.Call):
+                    inner = node.args[0]
+                    callee = dotted(inner.func)
+                    if callee and imports.canonical(callee) in (
+                            "functools.partial", "partial"):
+                        for a in inner.args[1:]:
+                            if isinstance(a, ast.Name) and a.id in typed:
+                                findings.append(Finding(
+                                    rule=self.id, path=module.relpath,
+                                    line=node.lineno,
+                                    message=(
+                                        f"`{a.id}` ({typed[a.id]}, a "
+                                        "non-frozen dataclass) bound into a "
+                                        "jitted partial — jit hashes bound "
+                                        "args as cache keys"),
+                                    symbol=module.symbol_for(node)))
+        return findings
+
+    # -- RH3: closures over mutable state ------------------------------------
+
+    def _mutable_closures(self, module: Module, imports: ImportMap
+                          ) -> List[Finding]:
+        findings: List[Finding] = []
+        for outer in [n for n in ast.walk(module.tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))]:
+            mutable_locals: Dict[str, int] = {}
+            for node in outer.body:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                            sub.value, _MUTABLE_LITERALS):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                mutable_locals[t.id] = sub.lineno
+            if not mutable_locals:
+                continue
+            # jitted nested functions / lambdas inside `outer`
+            for node in ast.walk(outer):
+                target: Optional[ast.AST] = None
+                line = 0
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not outer:
+                    for dec in node.decorator_list:
+                        callee = dec.func if isinstance(dec, ast.Call) else dec
+                        name = dotted(callee)
+                        if name and imports.canonical(name) in _JIT_CALLEES:
+                            target, line = node, node.lineno
+                elif isinstance(node, ast.Call) and _is_jit(node, imports) \
+                        and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Lambda):
+                        target, line = arg, node.lineno
+                    elif isinstance(arg, ast.Name):
+                        for sub in ast.walk(outer):
+                            if isinstance(sub, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)) \
+                                    and sub is not outer \
+                                    and sub.name == arg.id:
+                                target, line = sub, node.lineno
+                if target is None:
+                    continue
+                bound = self._bound_names(target)
+                body = target.body if isinstance(target.body, list) \
+                    else [target.body]
+                for sub in [s for b in body for s in ast.walk(b)]:
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load) \
+                            and sub.id in mutable_locals \
+                            and sub.id not in bound:
+                        findings.append(Finding(
+                            rule=self.id, path=module.relpath, line=line,
+                            message=(f"compiled closure captures mutable "
+                                     f"local `{sub.id}` (assigned a mutable "
+                                     f"literal at line "
+                                     f"{mutable_locals[sub.id]}) — captured "
+                                     "by value at trace time, later "
+                                     "mutations are silently ignored"),
+                            symbol=module.symbol_for(target)))
+                        break  # one finding per compiled closure
+        return findings
+
+    @staticmethod
+    def _bound_names(fn: ast.AST) -> Set[str]:
+        bound: Set[str] = set()
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + \
+                list(args.kwonlyargs):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for b in body for n in ast.walk(b)]:
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+        return bound

@@ -1,0 +1,26 @@
+"""The default rule set, in one place so runner and tests agree."""
+
+from __future__ import annotations
+
+from typing import List
+
+from distributed_tensorflow_tpu.analysis.core import Rule
+from distributed_tensorflow_tpu.analysis.hygiene import (
+    MutableDefaultRule,
+    UnusedImportRule,
+)
+from distributed_tensorflow_tpu.analysis.jit_purity import JitPurityRule
+from distributed_tensorflow_tpu.analysis.layering import LayeringRule
+from distributed_tensorflow_tpu.analysis.locks import LockDisciplineRule
+from distributed_tensorflow_tpu.analysis.recompile import RecompileHazardRule
+
+
+def default_rules() -> List[Rule]:
+    return [
+        JitPurityRule(),
+        RecompileHazardRule(),
+        LockDisciplineRule(),
+        LayeringRule(),
+        UnusedImportRule(),
+        MutableDefaultRule(),
+    ]

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import contextlib as _contextlib
 import logging
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import optax

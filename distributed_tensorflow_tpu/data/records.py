@@ -15,7 +15,6 @@ multi-host runs read disjoint slices.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import os
 from typing import Iterator, Optional

@@ -17,7 +17,7 @@ from flax import linen as nn
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_image_classification
 from distributed_tensorflow_tpu.models import Workload
-from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
+from distributed_tensorflow_tpu.parallel.sharding import ShardingRules
 
 
 class MnistCNN(nn.Module):

@@ -20,7 +20,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +30,7 @@ from flax import linen as nn
 
 from distributed_tensorflow_tpu.data.pipeline import synthetic_image_classification
 from distributed_tensorflow_tpu.models import Workload
-from distributed_tensorflow_tpu.parallel.sharding import P, ShardingRules
+from distributed_tensorflow_tpu.parallel.sharding import ShardingRules
 
 ModuleDef = Any
 

@@ -20,7 +20,7 @@ These wrappers exist so the rest of the framework never scatter-calls
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Sequence, Union
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ Three levels of API:
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np

@@ -544,7 +544,11 @@ class ContinuousScheduler:
         """One iteration: a (num_slots, 1) step over all slots, then
         retirement of every row that hit its eos or horizon."""
         with self._lock:
-            active_slots = list(self._active)
+            # Snapshot the slot->request map: close() clears self._active
+            # under the lock from another thread, so the loop below must
+            # not re-read it after this point.
+            snapshot = dict(self._active)
+        active_slots = list(snapshot)
         if not active_slots:
             return
         iter_start = time.monotonic()
@@ -553,7 +557,7 @@ class ContinuousScheduler:
         for slot in active_slots:
             # The upcoming step writes each slot's position
             # prompt + len(tokens) - 1; cross a block boundary -> allocate.
-            req = self._active[slot]
+            req = snapshot[slot]
             self._ensure_blocks(req, len(req.prompt) + len(req.tokens))
         tok_dev, self._cache = self.engine.decode_slots(
             self._cache, self._last_tok, active,
@@ -570,7 +574,7 @@ class ContinuousScheduler:
                 start=iter_start, end=time.monotonic(),
                 args={"active_slots": len(active_slots)})
         for slot in active_slots:
-            req = self._active[slot]
+            req = snapshot[slot]
             tok = int(toks[slot])
             req.tokens.append(tok)
             self._last_tok[slot, 0] = tok

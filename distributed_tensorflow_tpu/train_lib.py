@@ -17,17 +17,12 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu import cluster as cluster_lib
 from distributed_tensorflow_tpu.checkpoint import CheckpointManager
-from distributed_tensorflow_tpu.data import (
-    DevicePrefetchIterator,
-    per_host_batch_size,
-)
+from distributed_tensorflow_tpu.data import DevicePrefetchIterator
 from distributed_tensorflow_tpu.models import Workload, available_models, get_workload
 from distributed_tensorflow_tpu.parallel.sharding import batch_sharding
 from distributed_tensorflow_tpu.training import (

@@ -26,7 +26,6 @@ Prints one JSON line per (T, mode): ours_ms, jax_ms, ratio, and which wins.
 """
 
 import argparse
-import functools
 import json
 import os
 import statistics

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Static analysis gate: dttlint (always) + ruff (when installed).
+# Non-zero exit on any non-baselined finding from either tool.
+#
+#   scripts/lint.sh            # lint the whole tree
+#   scripts/lint.sh --json     # dttlint JSON output (ruff still text)
+set -u -o pipefail
+
+repo_root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo_root"
+
+rc=0
+
+echo "== dttlint =="
+python -m distributed_tensorflow_tpu.analysis "$@" || rc=1
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    # Config lives in pyproject.toml ([tool.ruff]); scope = pyflakes + B006.
+    ruff check . || rc=1
+else
+    # The container may not ship ruff; dttlint's unused-import /
+    # mutable-default rules cover the scoped set regardless.
+    echo "ruff not installed — skipped (dttlint hygiene rules still ran)"
+fi
+
+exit $rc

@@ -1,0 +1,182 @@
+"""Concurrency stress: ≥8 threads hammering the two shared hot objects —
+``obs.metrics.Registry`` and ``serve.DynamicBatcher`` — asserting no lost
+updates, no exceptions, and clean shutdown.  These are the dynamic
+counterpart of dttlint's static ``lock-discipline`` rule: the rule proves
+accesses sit under the lock, this proves the lock actually serializes
+them."""
+
+import threading
+import time
+from concurrent.futures import Future
+
+
+from distributed_tensorflow_tpu.obs.metrics import Registry
+from distributed_tensorflow_tpu.serve.batcher import (
+    DynamicBatcher,
+    ServeOverloadedError,
+)
+
+N_THREADS = 8
+OPS_PER_THREAD = 500
+
+
+def _run_threads(worker, n=N_THREADS):
+    """Start n workers against a barrier, join them, raise any errors."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrapped(i):
+        try:
+            barrier.wait(timeout=10)
+            worker(i)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrapped, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "stress worker wedged"
+    assert errors == [], errors
+
+
+class TestRegistryStress:
+    def test_counter_no_lost_updates(self):
+        registry = Registry()
+        counter = registry.counter("stress_total", "stress counter")
+
+        def worker(i):
+            for _ in range(OPS_PER_THREAD):
+                counter.inc()
+
+        _run_threads(worker)
+        assert counter.value == N_THREADS * OPS_PER_THREAD
+
+    def test_labeled_families_and_histograms_race_free(self):
+        registry = Registry()
+
+        def worker(i):
+            # Every thread races get-or-create on the SAME names: the
+            # registry must hand back one family, one child per label.
+            for k in range(OPS_PER_THREAD):
+                registry.counter(
+                    "stress_labeled", "labeled", labelnames=("t",)
+                ).labels(t=str(i % 4)).inc()
+                registry.histogram(
+                    "stress_hist", "hist", buckets=(0.1, 1.0, 10.0)
+                ).observe(float(k % 7))
+
+        _run_threads(worker)
+        total = sum(
+            child.value
+            for _labels, child in registry.counter(
+                "stress_labeled", "labeled", labelnames=("t",)).samples())
+        assert total == N_THREADS * OPS_PER_THREAD
+        hist = registry.histogram("stress_hist", "hist",
+                                  buckets=(0.1, 1.0, 10.0))
+        assert hist.count == N_THREADS * OPS_PER_THREAD
+
+    def test_stats_providers_register_during_reads(self):
+        registry = Registry()
+
+        def worker(i):
+            for k in range(100):
+                ns = registry.register_stats(
+                    f"stress/{i}/{k}", lambda: {"x": 1.0})
+                assert ns
+
+        _run_threads(worker)
+
+
+class TestBatcherStress:
+    def test_submit_from_8_threads_no_lost_requests(self):
+        processed = []
+        processed_lock = threading.Lock()
+
+        def run_batch(payloads):
+            with processed_lock:
+                processed.extend(payloads)
+            return [p * 2 for p in payloads]
+
+        batcher = DynamicBatcher(
+            run_batch, max_batch_size=16, batch_timeout_ms=1.0,
+            max_queue_size=10_000, name="stress")
+        results = []
+        results_lock = threading.Lock()
+
+        def worker(i):
+            futures = []
+            for k in range(OPS_PER_THREAD):
+                futures.append((i * OPS_PER_THREAD + k,
+                                batcher.submit(i * OPS_PER_THREAD + k)))
+            for payload, fut in futures:
+                assert fut.result(timeout=30) == payload * 2
+            with results_lock:
+                results.append(len(futures))
+
+        try:
+            _run_threads(worker)
+        finally:
+            batcher.close()
+        assert sum(results) == N_THREADS * OPS_PER_THREAD
+        assert sorted(processed) == list(range(N_THREADS * OPS_PER_THREAD))
+        stats = batcher.stats()
+        assert stats["submitted"] == N_THREADS * OPS_PER_THREAD
+        assert stats["completed"] == N_THREADS * OPS_PER_THREAD
+        assert stats["failed"] == 0
+
+    def test_shutdown_races_submit_cleanly(self):
+        # Half the threads submit while the main thread closes the
+        # batcher mid-flight: every future must resolve (result or
+        # RuntimeError/overload rejection) — nothing may hang or leak an
+        # unexpected exception type.
+        def run_batch(payloads):
+            time.sleep(0.001)
+            return payloads
+
+        batcher = DynamicBatcher(
+            run_batch, max_batch_size=4, batch_timeout_ms=1.0,
+            max_queue_size=256, name="stress-shutdown")
+        futures = []
+        futures_lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker(i):
+            while not stop.is_set():
+                try:
+                    fut = batcher.submit(i)
+                except (ServeOverloadedError, RuntimeError):
+                    continue  # overload or already-closed are both clean
+                with futures_lock:
+                    futures.append(fut)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        batcher.close(timeout=10.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "submitter wedged after close()"
+        resolved = 0
+        for fut in futures:
+            assert isinstance(fut, Future)
+            try:
+                fut.result(timeout=10)
+                resolved += 1
+            except RuntimeError:
+                resolved += 1  # drained-at-shutdown rejection is clean
+        assert resolved == len(futures)
+
+    def test_close_is_idempotent_under_contention(self):
+        batcher = DynamicBatcher(lambda p: p, max_batch_size=2,
+                                 batch_timeout_ms=1.0, name="stress-close")
+
+        def worker(i):
+            batcher.close(timeout=5.0)
+
+        _run_threads(worker)

@@ -183,11 +183,17 @@ def _serve_bench(flags):
     # (spans are host-side only, so throughput numbers are unaffected).
     tracer = default_tracer()
     tracer.enable()
+    # Fleet variant: the SAME continuous traffic over 2 replicas behind
+    # the load-aware router (replica 0 reuses the bench engine).  One
+    # process, so no throughput claim on CPU — the line carries the
+    # dispatch spread and shed count as the router's smoke evidence.
+    fleet = dataclasses.replace(continuous, num_replicas=2)
     try:
         fixed_res = run_serve(fixed, engine=engine)
         cont_res = run_serve(continuous, engine=engine)
         paged_res = run_serve(paged, engine=engine)
         int8_res = run_serve(paged_int8, engine=engine)
+        fleet_res = run_serve(fleet, engine=engine)
     finally:
         engine.close()
     trace_events = len(tracer)
@@ -236,6 +242,13 @@ def _serve_bench(flags):
         "block_utilization": round(
             paged_res["blocks_high_water"]
             / max(paged_res["blocks_total"], 1), 4),
+        "fleet_tokens_per_sec": fleet_res["tokens_per_sec"],
+        "fleet_speedup": round(
+            fleet_res["tokens_per_sec"]
+            / max(cont_res["tokens_per_sec"], 1e-9), 3),
+        "fleet_replicas": fleet_res["num_replicas"],
+        "fleet_dispatch": fleet_res["fleet_dispatch"],
+        "fleet_shed": fleet_res["fleet_shed"],
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

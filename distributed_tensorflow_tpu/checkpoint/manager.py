@@ -76,6 +76,21 @@ class CheckpointManager:
     def all_steps(self):
         return self._mngr.all_steps()
 
+    def poll(self) -> Optional[int]:
+        """Cheap watcher surface: re-scan the directory and return the
+        newest step — no restore, no template.  Orbax caches its step
+        listing, so ``latest_step()`` alone never notices checkpoints
+        written by ANOTHER process (or another manager instance); the
+        fleet's checkpoint watcher needs the fresh ``reload()`` scan.
+        Returns None when no checkpoint exists yet or after ``close()``.
+        """
+        if self._mngr is None:
+            return None
+        reload_fn = getattr(self._mngr, "reload", None)
+        if callable(reload_fn):  # older orbax has no reload(); scan below
+            reload_fn()
+        return self._mngr.latest_step()
+
     def save(self, step: int, state: PyTree, *, force: bool = False) -> bool:
         """Save ``state`` at ``step`` (async by default; returns whether a
         save was started, honoring save_interval_steps like TF's manager)."""

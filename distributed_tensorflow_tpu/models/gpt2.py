@@ -138,6 +138,11 @@ class PagedKVConfig:
     block_size: int = 16
     num_blocks: int = 64
     kv_dtype: Optional[str] = None  # None | "int8" | a jnp dtype name
+    # Per-shard pools (fleet serving): partition the pool's block dimension
+    # over the data axis — shard s owns blocks [s*per, (s+1)*per) with its
+    # own trash block at s*per, and a slot's table only ever indexes its
+    # shard.  1 keeps today's data-axis-replicated pool.
+    data_shards: int = 1
 
     def __post_init__(self):
         if self.block_size < 1:
@@ -146,6 +151,18 @@ class PagedKVConfig:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
                 f"block), got {self.num_blocks}")
+        if self.data_shards < 1:
+            raise ValueError(
+                f"data_shards must be >= 1, got {self.data_shards}")
+        if self.num_blocks % self.data_shards:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} must divide evenly over "
+                f"data_shards {self.data_shards} per-shard pools")
+        if self.num_blocks // self.data_shards < 2:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} leaves fewer than 2 blocks "
+                f"per shard across data_shards {self.data_shards} (each "
+                f"shard reserves its own trash block)")
         if self.kv_dtype is not None:
             jnp.dtype(self.kv_dtype)  # fail fast on typos
 
@@ -167,8 +184,21 @@ class PagedKVConfig:
 
     @property
     def usable_blocks(self) -> int:
-        """Blocks available to requests (pool minus the trash block)."""
-        return self.num_blocks - 1
+        """Blocks available to requests (pool minus the trash blocks)."""
+        return self.num_blocks - self.data_shards
+
+    @property
+    def blocks_per_shard(self) -> int:
+        return self.num_blocks // self.data_shards
+
+    @property
+    def usable_blocks_per_shard(self) -> int:
+        """Blocks one data shard can hand to requests — the admission
+        bound in per-shard mode (a shard cannot borrow a peer's blocks)."""
+        return self.blocks_per_shard - 1
+
+    def trash_block(self, shard: int = 0) -> int:
+        return shard * self.blocks_per_shard
 
 
 def _quantize_kv_int8(x):
@@ -785,7 +815,7 @@ def gpt2_rules() -> ShardingRules:
     )
 
 
-def gpt2_cache_rules() -> ShardingRules:
+def gpt2_cache_rules(per_shard_pools: bool = False) -> ShardingRules:
     """Sharding for the decode KV cache ("cache" collection).
 
     Cached k/v are (B, S, H, head_dim) — (L, B, S, H, head_dim) under the
@@ -793,18 +823,39 @@ def gpt2_cache_rules() -> ShardingRules:
     over ``tensor``, matching the column-parallel qkv projection the cache
     is written from (``transformer_rules``), so decode runs TP without any
     resharding at the cache boundary.  Scalar indices stay replicated.
+
+    ``per_shard_pools=True`` (``PagedKVConfig.data_shards > 1``) shards the
+    paged pools' block dimension over the data axes as well: the allocator
+    partitions block ids contiguously per data shard and pins every slot's
+    table to its own shard, so each data shard holds ``num_blocks / data``
+    physical blocks instead of a full replica — per-device KV HBM drops by
+    the data-axis width.  Scale tables shard the same way (they are
+    per-block rows).
     """
-    return ShardingRules(
-        [
-            # Paged pools (L, num_blocks, block_size, H, hd): the block dim
-            # is NOT a batch dim — any slot's tokens can live in any block —
-            # so only heads shard (over ``tensor``, same layout the qkv
-            # projection writes); data-sharded per-shard pools are the
-            # multi-host-serve item (ROADMAP).  Scale tables replicate.
+    if per_shard_pools:
+        pool_rules = [
+            (r"blocks/cached_(key|value)_pool",
+             P(None, ("data", "fsdp"), None, "tensor", None)),
+            (r"cached_(key|value)_pool",
+             P(("data", "fsdp"), None, "tensor", None)),
+            (r"blocks/(key|value)_scale", P(None, ("data", "fsdp"))),
+            (r"(key|value)_scale", P(("data", "fsdp"))),
+        ]
+    else:
+        pool_rules = [
+            # Paged pools (L, num_blocks, block_size, H, hd): in the
+            # replicated layout the block dim is NOT a batch dim — any
+            # slot's tokens can live in any block — so only heads shard
+            # (over ``tensor``, same layout the qkv projection writes);
+            # scale tables replicate.
             (r"blocks/cached_(key|value)_pool",
              P(None, None, None, "tensor", None)),
             (r"cached_(key|value)_pool", P(None, None, "tensor", None)),
             (r"(key|value)_scale", P()),
+        ]
+    return ShardingRules(
+        pool_rules
+        + [
             (r"blocks/cached_(key|value)",
              P(None, ("data", "fsdp"), None, "tensor")),
             (r"cached_(key|value)", P(("data", "fsdp"), None, "tensor")),

@@ -18,6 +18,10 @@ Layers:
   (``models.gpt2.PagedKVConfig``);
 - ``driver``: the in-process request loop behind ``serve.py`` and
   ``bench.py --mode=serve`` (``run_serve`` / ``ServeArgs``);
+- ``fleet``: multi-replica serving — ``FleetRouter`` dispatches over N
+  ``Replica`` engines by load (queue depth, occupancy, free blocks) and
+  ``CheckpointWatcher`` hot-reloads new checkpoint steps without
+  dropping in-flight requests;
 - ``obs.ServeMonitorHook`` exports the batcher's/scheduler's counters
   (queue depth, occupancy, TTFT/TPOT).
 """
@@ -29,6 +33,11 @@ from distributed_tensorflow_tpu.serve.batcher import (
 from distributed_tensorflow_tpu.serve.continuous import ContinuousScheduler
 from distributed_tensorflow_tpu.serve.driver import ServeArgs, run_serve
 from distributed_tensorflow_tpu.serve.engine import ServeEngine, pad_rows
+from distributed_tensorflow_tpu.serve.fleet import (
+    CheckpointWatcher,
+    FleetRouter,
+    Replica,
+)
 from distributed_tensorflow_tpu.serve.paged import (
     BlockAllocator,
     BlockExhaustedError,
@@ -37,8 +46,11 @@ from distributed_tensorflow_tpu.serve.paged import (
 __all__ = [
     "BlockAllocator",
     "BlockExhaustedError",
+    "CheckpointWatcher",
     "ContinuousScheduler",
     "DynamicBatcher",
+    "FleetRouter",
+    "Replica",
     "ServeArgs",
     "ServeEngine",
     "ServeOverloadedError",

@@ -231,6 +231,27 @@ class DynamicBatcher:
                 "queue_wait_p99_ms": _percentile(qw, 0.99),
             }
 
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful-shutdown phase 1: stop admitting and let in-flight
+        work finish, up to ``timeout`` seconds.  Iteration-level mode
+        delegates to the scheduler's drain (resident slots finish their
+        streams; the queued backlog is shed with ``ServeOverloadedError``).
+        Request-level mode has no resident state worth waiting on beyond
+        ``close()``'s own in-flight batch handling, so it waits for the
+        pending queue to empty.  Returns True when everything in flight
+        completed; submissions during/after a drain are shed with
+        ``ServeOverloadedError`` (iteration-level) until ``close()``."""
+        if self._scheduler is not None:
+            return bool(self._scheduler.drain(timeout))
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            with self._lock:
+                if self._depth == 0 or self._stopped:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
     def close(self, timeout: float = 10.0) -> None:
         """Stop the scheduler; fail any still-pending futures.
 

@@ -27,6 +27,26 @@ exported by ``obs.ServeMonitorHook``.
 
 Admission control mirrors ``DynamicBatcher``: a bounded queue that rejects
 with ``ServeOverloadedError`` instead of growing tail latency unboundedly.
+
+Fleet extensions (``serve/fleet``):
+
+- HOT WEIGHT RELOAD — ``update_params`` stages a new generation-tagged
+  params tree; the loop swaps it in at the top of its next iteration.
+  Requests pin the generation current at ADMISSION (``_ParamGeneration``
+  refcount), in-flight decodes finish on the weights they started with
+  (the iteration groups rows by generation, one ``decode_slots`` call per
+  live generation — normally exactly one), and a superseded generation's
+  params are dropped when its refcount drains to zero.  Each resolved
+  Future carries its ``generation`` tag.
+- PER-SHARD KV POOLS — ``per_shard_kv=True`` (paged mode) partitions the
+  block pool over the mesh's data axis: the device pools shard their
+  block dim (``gpt2_cache_rules(per_shard_pools=True)``), the allocator
+  partitions block ids contiguously per shard, and every slot is pinned
+  to the data shard its rows live on — block tables only ever index local
+  blocks, so per-device KV HBM drops by the data-axis width.
+- GRACEFUL DRAIN — ``drain()`` stops admissions (submit sheds with
+  ``ServeOverloadedError``), fails the queued-but-unadmitted backlog, and
+  waits for every resident slot to finish before the caller ``close()``s.
 """
 
 from __future__ import annotations
@@ -99,6 +119,9 @@ class _SlotRequest:
     # block admission (the reservation-wait span's start).
     rid: int = 0
     blocked_since: Optional[float] = None
+    # Hot reload: the param generation pinned at admission (the request
+    # decodes on these weights even if a newer generation lands mid-flight).
+    gen: Optional["_ParamGeneration"] = None
 
     def done(self) -> bool:
         if len(self.tokens) >= self.max_new_tokens:
@@ -111,6 +134,19 @@ class _SlotRequest:
         one per decode step (the last generated token never re-enters the
         cache)."""
         return len(self.prompt) + self.max_new_tokens - 1
+
+
+@dataclasses.dataclass
+class _ParamGeneration:
+    """One weight generation: a sharded params tree, its checkpoint-step
+    tag, and a refcount of in-flight requests pinned to it.  The scheduler
+    mutates ``refs`` only under its lock; when a SUPERSEDED generation's
+    refcount drains to zero its ``params`` reference is dropped so the
+    device buffers actually free."""
+
+    params: Any
+    generation: int
+    refs: int = 0
 
 
 class ContinuousScheduler:
@@ -142,6 +178,7 @@ class ContinuousScheduler:
         block_size: int = 16,
         num_blocks: Optional[int] = None,
         kv_dtype: Optional[str] = None,
+        per_shard_kv: bool = False,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -157,6 +194,10 @@ class ContinuousScheduler:
             raise ValueError(
                 "kv_dtype applies to cache_mode='paged' only (the dense "
                 "cache stores the model's compute dtype)")
+        if per_shard_kv and cache_mode != "paged":
+            raise ValueError(
+                "per_shard_kv partitions the paged block pool — it "
+                "requires cache_mode='paged'")
         self.engine = engine
         self.num_slots = engine.bucket_rows(max(1, num_slots))
         self.max_total_len = int(max_total_len or cfg.n_positions)
@@ -166,27 +207,45 @@ class ContinuousScheduler:
         self.top_k = int(top_k)
         self.cache_mode = cache_mode
         self.block_size = int(block_size)
+        shards = 1
         if cache_mode == "paged":
             from distributed_tensorflow_tpu.models.gpt2 import PagedKVConfig
 
+            if per_shard_kv:
+                shards = max(1, engine.data_parallelism)
             per_slot = -(-self.max_total_len // self.block_size)
             if num_blocks is None:
                 # Safe default: full capacity (every slot at max length)
-                # plus the trash block — no savings until sized down, but
-                # never any block-wait either.
-                num_blocks = self.num_slots * per_slot + 1
+                # plus the trash block(s) — no savings until sized down,
+                # but never any block-wait either.
+                num_blocks = self.num_slots * per_slot + shards
+            else:
+                # Per-shard pools partition the id space evenly; round a
+                # hand-picked pool UP to the next multiple of the shard
+                # count rather than rejecting it.
+                num_blocks = -(-int(num_blocks) // shards) * shards
             self.paged: Optional["PagedKVConfig"] = PagedKVConfig(
                 block_size=self.block_size, num_blocks=int(num_blocks),
-                kv_dtype=kv_dtype)
+                kv_dtype=kv_dtype, data_shards=shards)
             self._cache = engine.init_paged_cache(
                 self.num_slots, self.max_total_len, paged=self.paged)
             self._allocator: Optional[BlockAllocator] = BlockAllocator(
-                self.paged.num_blocks, self.block_size)
-            # Host-owned logical->physical map, one row per slot; all-zero
-            # rows (and entries past a slot's allocation) point at trash
-            # block 0.  Passed into every prefill/decode call.
+                self.paged.num_blocks, self.block_size, num_shards=shards)
+            # Slot -> data shard: contiguous ranges, matching how
+            # ``batch_sharding`` partitions the (num_slots, 1) decode rows
+            # over the data axes — slot s's rows and its blocks live on
+            # the same devices.
+            self._slot_shard = [s * shards // self.num_slots
+                                for s in range(self.num_slots)]
+            # Host-owned logical->physical map, one row per slot; rows
+            # (and entries past a slot's allocation) point at the slot's
+            # shard's trash block (block 0 in single-shard mode).  Passed
+            # into every prefill/decode call.
             self._block_tables = np.zeros(
                 (self.num_slots, per_slot), np.int32)
+            for s in range(self.num_slots):
+                self._block_tables[s, :] = self._allocator.trash_block(
+                    self._slot_shard[s])
             self._slot_blocks: Dict[int, List[int]] = {
                 s: [] for s in range(self.num_slots)}
         else:
@@ -194,10 +253,14 @@ class ContinuousScheduler:
             self._allocator = None
             self._block_tables = None
             self._slot_blocks = {}
+            self._slot_shard = [0] * self.num_slots
             self._cache = engine.init_slot_cache(
                 self.num_slots, self.max_total_len)
         self.kv_hbm_bytes = int(engine.cache_hbm_bytes(self._cache))
-        self._reserved = 0  # paged: reserved-but-unallocated blocks
+        self.kv_hbm_bytes_per_shard = int(
+            engine.cache_hbm_bytes_per_shard(self._cache))
+        # paged: reserved-but-unallocated blocks, per shard
+        self._reserved = [0] * shards
         self._blocks_per_request: collections.deque = collections.deque(
             maxlen=1024)
         self._blocks_hist: collections.Counter = collections.Counter()
@@ -208,6 +271,15 @@ class ContinuousScheduler:
         self._cond = threading.Condition(self._lock)
         self._queue: "collections.deque[_SlotRequest]" = collections.deque()
         self._stopped = False
+        self._draining = False
+        # Hot reload: the generation new admissions pin, and the staged
+        # next generation the loop swaps in at its next iteration top.
+        # The initial generation aliases the engine's own params (no extra
+        # device memory) and tags the restored checkpoint step (0 fresh).
+        self._gen = _ParamGeneration(
+            params=engine.params,
+            generation=int(engine.restored_step or 0))
+        self._pending_gen: Optional[_ParamGeneration] = None
         # counters (under _lock)
         self._submitted = 0
         self._rejected = 0
@@ -264,13 +336,15 @@ class ContinuousScheduler:
                 f"submit instead")
         if self.paged is not None:
             need = self.paged.blocks_for(len(prompt) + max_new_tokens - 1)
-            if need > self._allocator.capacity:
+            # Per-shard pools: a request's whole footprint must fit the
+            # ONE shard its slot will be pinned to — peers cannot lend.
+            if need > self._allocator.capacity_per_shard:
                 raise ValueError(
                     f"request needs up to {need} KV blocks (prompt "
                     f"{len(prompt)} + max_new_tokens {max_new_tokens}, "
                     f"block_size {self.block_size}) but the pool only has "
-                    f"{self._allocator.capacity} usable blocks — it could "
-                    f"never be admitted")
+                    f"{self._allocator.capacity_per_shard} usable blocks "
+                    f"per shard — it could never be admitted")
         req = _SlotRequest(
             prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token=self.eos_token if eos_token is None else eos_token,
@@ -278,6 +352,11 @@ class ContinuousScheduler:
         with self._cond:
             if self._stopped:
                 raise RuntimeError("ContinuousScheduler is closed")
+            if self._draining:
+                self._rejected += 1
+                self._obs["rejected"].inc()
+                raise ServeOverloadedError(
+                    "scheduler is draining — not admitting new requests")
             if len(self._queue) >= self.max_queue_size:
                 self._rejected += 1
                 self._obs["rejected"].inc()
@@ -287,6 +366,9 @@ class ContinuousScheduler:
             self._queue.append(req)
             self._submitted += 1
             req.rid = self._submitted
+            # The router stitches its route span into this request's
+            # trace lane through the Future.
+            req.future.rid = req.rid
             self._obs["submitted"].inc()
             self._obs["depth"].set(len(self._queue))
             self._cond.notify()
@@ -303,6 +385,62 @@ class ContinuousScheduler:
         if isinstance(payload, tuple) and len(payload) == 2:
             return self.submit(payload[0], max_new_tokens=int(payload[1]))
         return self.submit(payload)
+
+    # -- hot weight reload ----------------------------------------------------
+
+    def update_params(self, params: Any, *, generation: int) -> None:
+        """Stage a new weight generation (fleet checkpoint watcher).
+
+        ``params`` must already be device-sharded through the engine's
+        rules (``ServeEngine.shard_params``) with the same avals as the
+        serving params — the slot programs take params as their
+        non-donated first argument, so the swap never recompiles.  The
+        loop installs the staged generation at the top of its next
+        iteration: requests already admitted keep decoding on the
+        generation they pinned; every admission after the swap pins the
+        new one.  Back-to-back updates before the loop wakes coalesce —
+        only the newest staged generation is ever installed.
+        """
+        staged = _ParamGeneration(params=params, generation=int(generation))
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("ContinuousScheduler is closed")
+            self._pending_gen = staged
+            self._cond.notify_all()
+
+    @property
+    def generation(self) -> int:
+        """The checkpoint-step tag new admissions currently pin."""
+        with self._lock:
+            return self._gen.generation
+
+    # -- graceful drain -------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful-shutdown phase 1: stop admitting (``submit`` sheds
+        with ``ServeOverloadedError``), fail the queued-but-unadmitted
+        backlog the same way, and wait up to ``timeout`` seconds for every
+        RESIDENT slot to finish its stream.  Returns True when all active
+        slots retired in time.  Call ``close()`` afterwards; idempotent
+        and safe to call on an already-stopped scheduler."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            shed = [r for r in self._queue if not r.future.done()]
+            self._queue.clear()
+            self._rejected += len(shed)
+            if shed:
+                self._obs["rejected"].inc(len(shed))
+            self._obs["depth"].set(0)
+            self._cond.notify_all()
+        for req in shed:
+            req.future.set_exception(ServeOverloadedError(
+                "scheduler draining: request shed before admission"))
+        with self._cond:
+            finished = self._cond.wait_for(
+                lambda: not self._active or self._stopped,
+                timeout=max(0.0, deadline - time.monotonic()))
+        return bool(finished)
 
     @property
     def paged_equivalent_blocks(self) -> int:
@@ -333,6 +471,7 @@ class ContinuousScheduler:
         out["blocks_per_request_max"] = float(per_req[-1]) if per_req else 0.0
         out["block_size"] = float(self.block_size)
         out["kv_hbm_bytes"] = float(self.kv_hbm_bytes)
+        out["kv_hbm_bytes_per_shard"] = float(self.kv_hbm_bytes_per_shard)
         return out
 
     def blocks_per_request_hist(self) -> Dict[int, int]:
@@ -379,6 +518,7 @@ class ContinuousScheduler:
                 "tpot_mean_ms": (sum(tpot) / len(tpot)) if tpot else 0.0,
                 "queue_wait_p50_ms": _percentile(qw, 0.50),
                 "queue_wait_p99_ms": _percentile(qw, 0.99),
+                "param_generation": float(self._gen.generation),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -419,14 +559,30 @@ class ContinuousScheduler:
                 admits: List[_SlotRequest] = []
                 with self._cond:
                     while (not self._stopped and not self._active
-                           and not self._queue):
+                           and not self._queue
+                           and self._pending_gen is None):
                         self._cond.wait()
                     if self._stopped:
                         return
+                    if self._pending_gen is not None:
+                        # Install the staged weight generation: every
+                        # admission from here on pins it; rows already
+                        # active keep their own generation's params.
+                        old, self._gen = self._gen, self._pending_gen
+                        self._pending_gen = None
+                        if old.refs == 0:
+                            old.params = None  # nothing in flight holds it
+                        logger.info(
+                            "hot-swapped params: generation %d -> %d "
+                            "(%d request(s) still on the old weights)",
+                            old.generation, self._gen.generation, old.refs)
                     while (self._queue and self._free
-                           and self._can_admit(self._queue[0])):
+                           and not self._draining):
+                        idx = self._pick_slot_locked(self._queue[0])
+                        if idx is None:
+                            break  # head of line waits on KV blocks
                         req = self._queue.popleft()
-                        req.slot = self._free.pop()
+                        req.slot = self._free.pop(idx)
                         if self.paged is not None:
                             # Reserve the worst-case block count now so a
                             # mid-decode boundary cross can always be
@@ -434,7 +590,10 @@ class ContinuousScheduler:
                             # never a half-decoded stream.
                             req.reserved_blocks = self.paged.blocks_for(
                                 req.max_written_tokens())
-                            self._reserved += req.reserved_blocks
+                            self._reserved[self._slot_shard[req.slot]] += (
+                                req.reserved_blocks)
+                        req.gen = self._gen
+                        self._gen.refs += 1
                         admits.append(req)
                     if (self.paged is not None and self._queue
                             and self._free
@@ -458,15 +617,30 @@ class ContinuousScheduler:
                 if not req.future.done():
                     req.future.set_exception(e)
 
-    def _can_admit(self, req: _SlotRequest) -> bool:
-        """Paged admission also waits on blocks: the pool must cover the
-        request's worst-case footprint BEYOND what is already promised to
-        in-flight requests (their unallocated reservations).  Head-of-line
-        only — no skipping, so admission order stays FIFO."""
+    def _pick_slot_locked(self, req: _SlotRequest) -> Optional[int]:
+        """Index into ``self._free`` of the slot to admit ``req`` into, or
+        None when no shard can cover its worst-case block footprint (the
+        head of line then waits — no skipping, so admission stays FIFO).
+
+        Paged admission also waits on blocks: the slot's shard must cover
+        the request's footprint BEYOND what is already promised to
+        in-flight requests there (their unallocated reservations).  With
+        several eligible shards the one with the most headroom wins
+        (load-levelling the pools); single-shard and dense modes keep the
+        classic pop-last (LIFO slot reuse) behaviour exactly."""
+        if not self._free:
+            return None
         if self.paged is None:
-            return True
+            return len(self._free) - 1
         need = self.paged.blocks_for(req.max_written_tokens())
-        return self._allocator.free_count - self._reserved >= need
+        best, best_headroom = None, need - 1
+        for i in range(len(self._free) - 1, -1, -1):
+            sh = self._slot_shard[self._free[i]]
+            headroom = (self._allocator.free_count_shard(sh)
+                        - self._reserved[sh])
+            if headroom > best_headroom:
+                best, best_headroom = i, headroom
+        return best
 
     def _ensure_blocks(self, req: _SlotRequest, tokens_written: int) -> None:
         """Allocate-on-boundary-cross: grow the slot's block list (and its
@@ -479,13 +653,15 @@ class ContinuousScheduler:
         needed = self.paged.blocks_for(tokens_written)
         if needed <= len(blocks):
             return
-        fresh = self._allocator.allocate(needed - len(blocks), slot=req.slot)
+        shard = self._slot_shard[req.slot]
+        fresh = self._allocator.allocate(
+            needed - len(blocks), slot=req.slot, shard=shard)
         self._block_tables[req.slot, len(blocks):needed] = fresh
         blocks.extend(fresh)
         with self._lock:
             release = min(req.reserved_blocks, len(fresh))
             req.reserved_blocks -= release
-            self._reserved -= release
+            self._reserved[shard] -= release
 
     def _paged_call_kwargs(self) -> Dict[str, Any]:
         if self.paged is None:
@@ -515,7 +691,8 @@ class ContinuousScheduler:
             tok_dev, self._cache = self.engine.prefill_into_slots(
                 self._cache, req.prompt[None, :], [req.slot],
                 temperature=self.temperature, top_k=self.top_k,
-                counter=self._next_counter(), **self._paged_call_kwargs())
+                counter=self._next_counter(), params=req.gen.params,
+                **self._paged_call_kwargs())
             tok = int(np.asarray(jax.device_get(tok_dev))[0])
             req.first_token_at = time.monotonic()
             req.tokens.append(tok)
@@ -552,18 +729,35 @@ class ContinuousScheduler:
         if not active_slots:
             return
         iter_start = time.monotonic()
-        active = np.zeros((self.num_slots,), bool)
-        active[active_slots] = True
         for slot in active_slots:
             # The upcoming step writes each slot's position
             # prompt + len(tokens) - 1; cross a block boundary -> allocate.
             req = snapshot[slot]
             self._ensure_blocks(req, len(req.prompt) + len(req.tokens))
-        tok_dev, self._cache = self.engine.decode_slots(
-            self._cache, self._last_tok, active,
-            temperature=self.temperature, top_k=self.top_k,
-            counter=self._next_counter(), **self._paged_call_kwargs())
-        toks = np.asarray(jax.device_get(tok_dev))
+        # Group rows by pinned weight generation: mid-reload, rows admitted
+        # before the swap keep decoding on their own params — one step per
+        # live generation, oldest first (normally exactly one group, and
+        # that single-group call is identical to the pre-reload path).  A
+        # group's step only advances ITS rows: the other generation's rows
+        # are inactive-masked, so their cache state stays frozen for their
+        # own step.
+        by_gen: Dict[int, List[int]] = {}
+        for slot in active_slots:
+            by_gen.setdefault(snapshot[slot].gen.generation, []).append(slot)
+        toks_by_slot: Dict[int, int] = {}
+        for generation in sorted(by_gen):
+            slots = by_gen[generation]
+            active = np.zeros((self.num_slots,), bool)
+            active[slots] = True
+            tok_dev, self._cache = self.engine.decode_slots(
+                self._cache, self._last_tok, active,
+                temperature=self.temperature, top_k=self.top_k,
+                counter=self._next_counter(),
+                params=snapshot[slots[0]].gen.params,
+                **self._paged_call_kwargs())
+            toks = np.asarray(jax.device_get(tok_dev))
+            for slot in slots:
+                toks_by_slot[slot] = int(toks[slot])
         with self._lock:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
@@ -572,10 +766,11 @@ class ContinuousScheduler:
             self._tracer.add_span(
                 "iteration", cat="serve", tid=0,
                 start=iter_start, end=time.monotonic(),
-                args={"active_slots": len(active_slots)})
+                args={"active_slots": len(active_slots),
+                      "generations": len(by_gen)})
         for slot in active_slots:
             req = snapshot[slot]
-            tok = int(toks[slot])
+            tok = toks_by_slot[slot]
             req.tokens.append(tok)
             self._last_tok[slot, 0] = tok
             if req.done():
@@ -600,21 +795,29 @@ class ContinuousScheduler:
                 args={"request_id": req.rid, "slot": req.slot})
         if self.paged is not None:
             # Bulk-free the slot's blocks and point its table row back at
-            # trash block 0 BEFORE the slot can go inactive — the shared
-            # decode step's garbage writes for idle rows must never land
-            # in a reallocated block.
+            # its shard's trash block BEFORE the slot can go inactive —
+            # the shared decode step's garbage writes for idle rows must
+            # never land in a reallocated block.
             blocks = self._slot_blocks[req.slot]
             used = len(blocks)
             if blocks:
                 self._allocator.free(blocks)
                 self._slot_blocks[req.slot] = []
-            self._block_tables[req.slot, :] = 0
+            self._block_tables[req.slot, :] = self._allocator.trash_block(
+                self._slot_shard[req.slot])
         else:
             used = self.paged_equivalent_blocks
         with self._lock:
             if self.paged is not None:
-                self._reserved -= req.reserved_blocks
+                self._reserved[self._slot_shard[req.slot]] -= (
+                    req.reserved_blocks)
                 req.reserved_blocks = 0
+            if req.gen is not None:
+                req.gen.refs -= 1
+                if req.gen is not self._gen and req.gen.refs == 0:
+                    # Last in-flight request on a superseded generation:
+                    # drop the params reference so device buffers free.
+                    req.gen.params = None
             self._blocks_per_request.append(used)
             self._blocks_hist[used] += 1
             self._active.pop(req.slot, None)
@@ -637,4 +840,12 @@ class ContinuousScheduler:
                     self._obs["tpot"].observe(
                         (req.finished_at - req.first_token_at)
                         / (len(req.tokens) - 1))
+            # Wake drain() waiters when the last resident slot retires.
+            self._cond.notify_all()
+        if req.gen is not None:
+            # Generation tag rides the Future: callers (and the fleet
+            # hot-reload tests) can assert which weights produced this
+            # stream.  Set BEFORE the result so no waiter observes a
+            # resolved future without its tag.
+            req.future.generation = req.gen.generation
         req.future.set_result(np.asarray(req.tokens, np.int32))

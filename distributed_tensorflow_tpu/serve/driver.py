@@ -86,6 +86,19 @@ class ServeArgs:
     # quantization with f32 scales; any jnp dtype name ("bfloat16", ...)
     # stores that dtype directly.
     kv_dtype: str = ""
+    # Partition the paged block pool over the mesh's data shards: each
+    # shard owns num_blocks/data blocks and slot tables index only their
+    # own shard's range (requires cache_mode="paged").
+    per_shard_kv: bool = False
+    # fleet (serve/fleet/): >1 runs N replica engines behind a
+    # load-aware FleetRouter (requires --continuous on gpt2).
+    num_replicas: int = 1
+    # >0 polls checkpoint_dir every that-many seconds and hot-reloads new
+    # steps into every replica without dropping in-flight requests.
+    reload_poll_s: float = 0.0
+    # graceful-drain budget on SIGTERM/KeyboardInterrupt: stop admitting,
+    # finish in-flight decodes, shed the still-queued.
+    drain_timeout_s: float = 10.0
     # sampling (greedy argmax when temperature == 0)
     temperature: float = 0.0
     top_k: int = 0
@@ -132,6 +145,7 @@ def _cache_kwargs(args: ServeArgs) -> Dict[str, Any]:
         "block_size": args.block_size,
         "num_blocks": args.num_blocks or None,
         "kv_dtype": args.kv_dtype or None,
+        "per_shard_kv": args.per_shard_kv,
     }
 
 
@@ -247,6 +261,52 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
     )
 
 
+def _make_fleet(args: ServeArgs, engine: ServeEngine):
+    """N replicas behind a ``FleetRouter``: replica 0 reuses the caller's
+    engine, the rest construct their own on the SAME mesh (same preset /
+    checkpoint / seed, so fresh-init replicas serve identical weights).
+    ``reload_poll_s > 0`` + a checkpoint dir attaches the hot-reload
+    watcher, owned (and closed) by the router."""
+    from distributed_tensorflow_tpu.serve.fleet import (
+        CheckpointWatcher,
+        FleetRouter,
+        Replica,
+    )
+
+    cfg = engine.module.cfg
+    need = max(p.shape[0] + m for p, m in
+               _make_requests(args, engine, np.random.default_rng(0)))
+    overrides: Dict[str, Any] = {}
+    preset = _auto_preset(args)
+    if preset:
+        overrides["preset"] = preset
+    replicas = []
+    for i in range(args.num_replicas):
+        eng = engine if i == 0 else ServeEngine(
+            args.model, mesh=engine.mesh,
+            checkpoint_dir=args.checkpoint_dir, seed=args.seed,
+            **overrides)
+        scheduler = ContinuousScheduler(
+            eng,
+            num_slots=args.num_slots,
+            max_total_len=min(cfg.n_positions, need),
+            max_queue_size=args.max_queue_size,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            name=f"serve-fleet-r{i}",
+            **_cache_kwargs(args),
+        )
+        replicas.append(Replica(i, eng, scheduler, owns_engine=(i > 0)))
+    watcher = None
+    if args.reload_poll_s > 0 and args.checkpoint_dir:
+        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+
+        watcher = CheckpointWatcher(
+            CheckpointManager(args.checkpoint_dir), replicas,
+            poll_interval_s=args.reload_poll_s, owns_manager=True)
+    return FleetRouter(replicas, watcher=watcher)
+
+
 def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
     """Compile outside the timed window: the fixed path warms the padded
     full-batch prefill+decode programs; the continuous path warms the
@@ -281,16 +341,28 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
     is_lm = args.model == "gpt2"
-    _warm(args, engine, payloads)
-
-    batcher = _make_batcher(args, engine)
+    fleet = is_lm and args.continuous and args.num_replicas > 1
+    if args.num_replicas > 1 and not fleet:
+        raise ValueError(
+            "--num_replicas > 1 requires the continuous gpt2 path "
+            "(--continuous); fixed-batch fleets are not a thing here")
+    if fleet:
+        batcher = _make_fleet(args, engine)
+        for rep in batcher.replicas:
+            _warm(args, rep.engine, payloads)
+    else:
+        _warm(args, engine, payloads)
+        batcher = _make_batcher(args, engine)
     monitor = ServeMonitorHook(batcher, every_steps=args.log_every)
     futures: List[Any] = [None] * len(payloads)
     rejected = [0]
     lock = threading.Lock()
+    stop = threading.Event()
 
     def client(cid: int) -> None:
         for i in range(cid, len(payloads), args.clients):
+            if stop.is_set():
+                return
             while True:
                 try:
                     f = batcher.submit(payloads[i])
@@ -298,7 +370,8 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
                 except ServeOverloadedError:
                     with lock:
                         rejected[0] += 1
-                    time.sleep(args.batch_timeout_ms / 1000.0)
+                    if stop.wait(args.batch_timeout_ms / 1000.0):
+                        return
             with lock:
                 futures[i] = f
             if (i + 1) % args.log_every == 0:
@@ -309,9 +382,40 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
                for c in range(max(1, args.clients))]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
-    results = [f.result(timeout=600.0) for f in futures]
+    interrupted = False
+    try:
+        # Join in short slices so a SIGTERM->KeyboardInterrupt (serve.py
+        # installs the handler) lands HERE, not inside a blocking join.
+        for t in threads:
+            while t.is_alive():
+                t.join(timeout=0.2)
+    except KeyboardInterrupt:
+        interrupted = True
+        stop.set()
+        logger.info(
+            "interrupt: graceful drain — no new admissions, in-flight "
+            "finish, queued shed (drain_timeout_s=%.1f)",
+            args.drain_timeout_s)
+        drain = getattr(batcher, "drain", None)
+        if callable(drain):
+            drain(args.drain_timeout_s)
+        for t in threads:
+            t.join(timeout=1.0)
+    if interrupted:
+        # Keep only the requests that finished before/during the drain;
+        # shed ones raised ServeOverloadedError and are dropped here.
+        results, done_payloads = [], []
+        for i, f in enumerate(futures):
+            if f is None or not f.done():
+                continue
+            try:
+                results.append(f.result(timeout=0.0))
+                done_payloads.append(payloads[i])
+            except Exception:  # noqa: BLE001 — shed/failed mid-drain
+                pass
+    else:
+        results = [f.result(timeout=600.0) for f in futures]
+        done_payloads = payloads
     elapsed = time.perf_counter() - t0
     stats = batcher.stats()
     batcher.close()
@@ -332,6 +436,16 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         "queue_wait_p99_ms": round(stats.get("queue_wait_p99_ms", 0.0), 3),
         "checkpoint_step": engine.restored_step,
     }
+    if interrupted:
+        out["drained"] = True
+    if fleet:
+        out["num_replicas"] = args.num_replicas
+        out["fleet_dispatch"] = [
+            int(stats.get(f"dispatch_replica_{i}", 0.0))
+            for i in range(args.num_replicas)]
+        out["fleet_shed"] = int(stats.get("shed", 0.0))
+        out["fleet_redispatched"] = int(stats.get("redispatched", 0.0))
+        out["param_generation"] = int(stats.get("param_generation", 0.0))
     if is_lm and args.continuous:
         out["slot_occupancy"] = round(stats["slot_occupancy"], 4)
         out["num_slots"] = int(stats["num_slots"])
@@ -365,8 +479,9 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         delivered = int(sum(len(r) for r in results))
         out["tokens_generated"] = delivered
         out["tokens_per_sec"] = round(delivered / max(elapsed, 1e-9), 2)
-        # Sanity surface for smoke tests: every result honors its horizon.
-        assert all(len(r) == m for r, (_, m) in zip(results, payloads))
+        # Sanity surface for smoke tests: every delivered result honors
+        # its horizon (a drained run only checks what actually finished).
+        assert all(len(r) == m for r, (_, m) in zip(results, done_payloads))
     else:
         out["examples_per_sec"] = round(completed / max(elapsed, 1e-9), 2)
         out["predictions"] = results[: min(8, len(results))]

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -42,6 +43,16 @@ from distributed_tensorflow_tpu.parallel.sharding import (
 
 logger = logging.getLogger(__name__)
 PyTree = Any
+
+# PROCESS-wide launch serialization for the slot programs (and the hot
+# reload's sharded device_put).  Fleet replicas all map onto this
+# process's one device set, and XLA runs a collective by parking one
+# participant thread per device on a SHARED pool until all arrive — two
+# replicas' concurrent launches interleave their participants on that
+# pool and deadlock the rendezvous.  One program in flight at a time is
+# what the hardware does anyway; the lock just makes the queueing happen
+# host-side instead of inside XLA's rendezvous.
+_launch_lock = threading.Lock()
 
 
 def _engine_instruments(registry=None):
@@ -312,11 +323,20 @@ class ServeEngine:
             raise ValueError(
                 f"max_total_len {total_len} exceeds n_positions "
                 f"{cfg.n_positions}")
+        if paged.data_shards > 1 and paged.data_shards != dp:
+            raise ValueError(
+                f"paged.data_shards {paged.data_shards} must equal the "
+                f"mesh's data-parallel extent {dp} (each data shard owns "
+                f"its own block pool)")
         max_blocks = paged.max_blocks_per_slot(total_len)
-        if paged.usable_blocks < max_blocks:
+        if paged.usable_blocks_per_shard < max_blocks:
+            shard_note = (f" per data shard (data_shards "
+                          f"{paged.data_shards})"
+                          if paged.data_shards > 1 else "")
             raise ValueError(
                 f"num_blocks {paged.num_blocks} cannot hold one "
-                f"max-length request: need {max_blocks} usable blocks "
+                f"max-length request: need {max_blocks} usable blocks"
+                f"{shard_note} "
                 f"(block_size {paged.block_size} x max_total_len "
                 f"{total_len}) plus the reserved trash block")
         from distributed_tensorflow_tpu.models.gpt2 import gpt2_cache_rules
@@ -337,7 +357,9 @@ class ServeEngine:
                 return vs["cache"]
 
             shapes = jax.eval_shape(mk)
-            shardings = gpt2_cache_rules().shardings_for(self.mesh, shapes)
+            shardings = gpt2_cache_rules(
+                per_shard_pools=paged.data_shards > 1,
+            ).shardings_for(self.mesh, shapes)
             self._cache_init_fns[key] = jax.jit(
                 lambda: jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), shapes),
@@ -347,12 +369,27 @@ class ServeEngine:
 
     @staticmethod
     def cache_hbm_bytes(cache: PyTree) -> int:
-        """Resident bytes of a KV cache tree (dense rows or paged pools +
-        scales + index vectors) — the serving-capacity denominator the
-        block-pool gauges and ``bench.py --mode=serve`` report."""
+        """GLOBAL resident bytes of a KV cache tree (dense rows or paged
+        pools + scales + index vectors) — the serving-capacity denominator
+        the block-pool gauges and ``bench.py --mode=serve`` report."""
         return int(sum(
             int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
             for leaf in jax.tree.leaves(cache)))
+
+    @staticmethod
+    def cache_hbm_bytes_per_shard(cache: PyTree) -> int:
+        """PER-DEVICE resident bytes of a KV cache tree: each leaf counts
+        one device's shard (``sharding.shard_shape``), so a pool whose
+        block dim is partitioned over the data axes reports
+        ``pool_bytes / data`` — the number that answers "how much HBM does
+        one chip spend on KV".  Replicated leaves count in full."""
+        total = 0
+        for leaf in jax.tree.leaves(cache):
+            sharding = getattr(leaf, "sharding", None)
+            shape = (sharding.shard_shape(leaf.shape)
+                     if sharding is not None else leaf.shape)
+            total += int(np.prod(shape)) * jnp.dtype(leaf.dtype).itemsize
+        return int(total)
 
     @staticmethod
     def _reset_slot_rows(cache: PyTree, slot_ids) -> PyTree:
@@ -389,7 +426,7 @@ class ServeEngine:
                            slot_ids: np.ndarray, *,
                            temperature: float = 0.0, top_k: int = 0,
                            rng=None, counter: int = 0,
-                           paged=None, block_tables=None):
+                           paged=None, block_tables=None, params=None):
         """Admit requests: slot-local prefill writing each prompt's K/V
         into its slot's rows of the RESIDENT cache (state rows reset
         first), returning (first generated tokens (n,), updated cache).
@@ -399,7 +436,13 @@ class ServeEngine:
         With ``paged`` (a ``PagedKVConfig``) the cache is the block-pool
         tree from ``init_paged_cache`` and ``block_tables`` the host's
         (num_slots, max_blocks_per_slot) int32 table, whose rows for
-        ``slot_ids`` must already cover each prompt's blocks."""
+        ``slot_ids`` must already cover each prompt's blocks.
+
+        ``params`` overrides ``self.params`` for this call (hot weight
+        reload: the scheduler pins each request to the param generation it
+        was admitted with).  Params are the NON-donated first argument of
+        the jitted program, so an override with the same avals/shardings
+        never recompiles."""
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim != 2:
             raise ValueError(f"prompts must be (n, T), got {prompts.shape}")
@@ -416,9 +459,10 @@ class ServeEngine:
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
         t0 = time.perf_counter()
-        out = self._generate_fns[key](
-            self.params, cache, prompts,
-            np.asarray(slot_ids, np.int32), bt, base, counter)
+        with _launch_lock:
+            out = self._generate_fns[key](
+                self.params if params is None else params, cache, prompts,
+                np.asarray(slot_ids, np.int32), bt, base, counter)
         self._obs["prefill"].observe(time.perf_counter() - t0)
         return out
 
@@ -452,7 +496,7 @@ class ServeEngine:
     def decode_slots(self, cache: PyTree, last_tokens: np.ndarray,
                      active: np.ndarray, *, temperature: float = 0.0,
                      top_k: int = 0, rng=None, counter: int = 0,
-                     paged=None, block_tables=None):
+                     paged=None, block_tables=None, params=None):
         """One iteration-level decode step over ALL slots: (num_slots, 1)
         tokens against the resident cache, per-slot offsets, inactive
         slots gated by ``active``.  Returns (next tokens (num_slots,),
@@ -461,7 +505,11 @@ class ServeEngine:
         Paged mode (``paged`` + ``block_tables``): inactive rows still
         scatter garbage K/V, but their table rows point at trash block 0
         (the scheduler resets them at retirement), so the garbage never
-        lands in a block owned by a live request."""
+        lands in a block owned by a live request.
+
+        ``params`` overrides ``self.params`` for this call (hot reload:
+        rows admitted before a weight swap keep decoding on their own
+        generation — same avals/shardings, so no recompile)."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         key = ("slot_decode", float(temperature), int(top_k), paged)
@@ -472,14 +520,16 @@ class ServeEngine:
                                   float(temperature), int(top_k), paged),
                 donate_argnums=(1,))
         base = rng if rng is not None else self._sample_rng
-        tokens_dev = jax.device_put(
-            np.asarray(last_tokens, np.int32), batch_sharding(self.mesh))
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
         t0 = time.perf_counter()
-        out = self._generate_fns[key](
-            self.params, cache, tokens_dev,
-            np.asarray(active, bool), bt, base, counter)
+        with _launch_lock:
+            tokens_dev = jax.device_put(
+                np.asarray(last_tokens, np.int32),
+                batch_sharding(self.mesh))
+            out = self._generate_fns[key](
+                self.params if params is None else params, cache,
+                tokens_dev, np.asarray(active, bool), bt, base, counter)
         self._obs["decode_step"].observe(time.perf_counter() - t0)
         return out
 
@@ -598,6 +648,18 @@ class ServeEngine:
         logits = self.classify(padded)
         return [int(np.argmax(logits[i], axis=-1))
                 for i in range(len(examples))]
+
+    # -- hot weight reload ----------------------------------------------------
+
+    def shard_params(self, params: PyTree) -> PyTree:
+        """Device-put a HOST params tree through the workload's sharding
+        rules — the fleet checkpoint watcher's reload path.  The result has
+        the same avals/shardings as ``self.params``, so passing it as the
+        ``params=`` override of the slot programs never recompiles."""
+        shardings = self.workload.rules.shardings_for(
+            self.mesh, {"params": params})
+        with _launch_lock:
+            return apply_shardings({"params": params}, shardings)["params"]
 
     # -- lifecycle -----------------------------------------------------------
 

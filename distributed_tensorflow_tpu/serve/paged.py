@@ -19,6 +19,15 @@ inactive decode rows still execute the shared ``(num_slots, 1)`` step and
 scatter garbage K/V somewhere — retired slots' table rows point all
 positions at block 0, so that garbage can never land in a block that has
 been reallocated to a live request.
+
+With ``num_shards > 1`` (per-shard KV pools, fleet serving) the id space
+partitions contiguously: shard ``s`` owns ``[s*per, (s+1)*per)`` where
+``per = num_blocks // num_shards``, and its FIRST block (``s*per``) is
+that shard's trash block.  Contiguous ownership matters because the
+device pool's block dimension is sharded over the data axis in the same
+order — a block id allocated from shard ``s`` physically lives on data
+shard ``s``'s devices, so a slot pinned to shard ``s`` only ever touches
+local HBM.  ``num_shards=1`` reduces exactly to the classic layout above.
 """
 
 from __future__ import annotations
@@ -57,22 +66,38 @@ class BlockExhaustedError(RuntimeError):
 class BlockAllocator:
     """Free-list allocator over ``num_blocks`` physical KV blocks.
 
-    Block 0 is reserved (trash); ``capacity`` is therefore
-    ``num_blocks - 1``.  Not thread-safe by itself — the scheduler calls it
-    only from its loop thread (or under its lock for stats).
+    Each shard's first block is reserved (trash); ``capacity`` is
+    therefore ``num_blocks - num_shards`` (``num_blocks - 1`` in the
+    default single-shard layout, where block 0 is the trash block).  Not
+    thread-safe by itself — the scheduler calls it only from its loop
+    thread (or under its lock for stats).
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
-        if num_blocks < 2:
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 num_shards: int = 1):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if num_blocks < 2 * num_shards:
             raise ValueError(
-                f"num_blocks must be >= 2 (block 0 is reserved as trash), "
-                f"got {num_blocks}")
+                f"num_blocks must be >= 2 per shard (each shard's first "
+                f"block is reserved as trash), got {num_blocks} for "
+                f"{num_shards} shard(s)")
+        if num_blocks % num_shards:
+            raise ValueError(
+                f"num_blocks {num_blocks} must divide evenly over "
+                f"{num_shards} shards")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        # LIFO free list: low ids at the end so fresh pools allocate 1, 2, …
-        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self.num_shards = int(num_shards)
+        self.blocks_per_shard = self.num_blocks // self.num_shards
+        per = self.blocks_per_shard
+        # Per-shard LIFO free lists: low ids at the end so a fresh shard
+        # allocates s*per+1, s*per+2, … (shard 0: 1, 2, … as before).
+        self._free_by_shard: List[List[int]] = [
+            list(range((s + 1) * per - 1, s * per, -1))
+            for s in range(self.num_shards)]
         self._owner: Dict[int, int] = {}  # block id -> slot id (debugging)
         self.high_water = 0
         self._obs = _block_instruments()
@@ -85,30 +110,49 @@ class BlockAllocator:
 
     @property
     def capacity(self) -> int:
-        return self.num_blocks - 1
+        return self.num_blocks - self.num_shards
+
+    @property
+    def capacity_per_shard(self) -> int:
+        return self.blocks_per_shard - 1
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free_by_shard)
 
     @property
     def used_count(self) -> int:
-        return self.capacity - len(self._free)
+        return self.capacity - self.free_count
+
+    def free_count_shard(self, shard: int) -> int:
+        return len(self._free_by_shard[shard])
+
+    def trash_block(self, shard: int = 0) -> int:
+        """The reserved never-allocated block absorbing inactive rows'
+        garbage scatter for ``shard`` (block 0 in the single-shard case)."""
+        return shard * self.blocks_per_shard
+
+    def shard_of(self, block: int) -> int:
+        return block // self.blocks_per_shard
 
     def blocks_for_tokens(self, tokens: int) -> int:
         """Blocks covering ``tokens`` logical positions."""
         return -(-max(0, int(tokens)) // self.block_size)
 
-    def allocate(self, n: int, *, slot: int = -1) -> List[int]:
-        """Pop ``n`` blocks off the free list; raises
-        ``BlockExhaustedError`` if fewer are free."""
+    def allocate(self, n: int, *, slot: int = -1,
+                 shard: int = 0) -> List[int]:
+        """Pop ``n`` blocks off ``shard``'s free list; raises
+        ``BlockExhaustedError`` if fewer are free there — a full peer
+        shard cannot lend blocks (they live on other devices)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        if n > len(self._free):
+        free = self._free_by_shard[shard]
+        if n > len(free):
+            where = f" in shard {shard}" if self.num_shards > 1 else ""
             raise BlockExhaustedError(
-                f"need {n} blocks, only {len(self._free)}/{self.capacity} "
-                f"free")
-        blocks = [self._free.pop() for _ in range(n)]
+                f"need {n} blocks, only {len(free)}/{self.capacity_per_shard}"
+                f" free{where}")
+        blocks = [free.pop() for _ in range(n)]
         for b in blocks:
             self._owner[b] = slot
         self.high_water = max(self.high_water, self.used_count)
@@ -117,22 +161,25 @@ class BlockAllocator:
         return blocks
 
     def free(self, blocks: List[int]) -> None:
-        """Return a slot's blocks to the pool (bulk-free on retire)."""
+        """Return a slot's blocks to the pool (bulk-free on retire); each
+        block routes back to the shard its id belongs to."""
         for b in blocks:
-            if b == TRASH_BLOCK:
-                raise ValueError("block 0 (trash) is never allocated/freed")
+            if b % self.blocks_per_shard == 0:
+                raise ValueError(
+                    f"block {b} (trash) is never allocated/freed")
+            shard_free = self._free_by_shard[self.shard_of(b)]
             if b in self._owner:
                 del self._owner[b]
-            elif b in self._free:
+            elif b in shard_free:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
-        if len(self._free) > self.capacity:
+            shard_free.append(b)
+        if self.free_count > self.capacity:
             raise AssertionError("freed more blocks than exist")
         self._obs["frees"].inc(len(blocks))
         self._publish_gauges()
 
     def stats(self) -> Dict[str, float]:
-        return {
+        out = {
             "blocks_total": float(self.capacity),
             "blocks_free": float(self.free_count),
             "blocks_in_use": float(self.used_count),
@@ -140,3 +187,8 @@ class BlockAllocator:
                                   if self.capacity else 0.0),
             "blocks_high_water": float(self.high_water),
         }
+        if self.num_shards > 1:
+            out["num_shards"] = float(self.num_shards)
+            out["blocks_free_min_shard"] = float(
+                min(len(f) for f in self._free_by_shard))
+        return out

@@ -10,5 +10,12 @@
 # the continuous-batching ones additionally marked serve_slow) out of the
 # gate; run them explicitly with:
 #   python -m pytest tests/ -q -m 'slow or serve_slow'
+#
+# The static-analysis gate (scripts/lint.sh — dttlint + ruff when
+# present) rides tier-1: a lint finding fails the gate even when every
+# test passes, but never masks a test failure's exit code.
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow and not serve_slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow and not serve_slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+bash scripts/lint.sh; lint_rc=$?
+[ "$rc" -eq 0 ] && rc=$lint_rc
+exit $rc

@@ -16,12 +16,20 @@ Examples:
         --block_size=16 --kv_dtype=int8                      # paged + int8 KV
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
+    python serve.py --model=gpt2 --continuous --num_replicas=2 \
+        --reload_poll_s=5 --checkpoint_dir=/tmp/ckpt  # fleet + hot reload
+
+SIGTERM (and Ctrl-C) triggers a graceful drain: no new admissions,
+in-flight decodes finish (bounded by --drain_timeout_s), queued requests
+are shed with backpressure errors.
 """
 
 import argparse
 import json
 import logging
 import os
+import signal
+import threading
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
@@ -86,6 +94,24 @@ def parse_args(argv=None):
                    help="paged mode: KV storage dtype — '' stores the "
                         "compute dtype, 'int8' quantizes per token with "
                         "f32 scales, or any jnp dtype name ('bfloat16')")
+    p.add_argument("--per_shard_kv", action="store_true",
+                   default=defaults.per_shard_kv,
+                   help="paged mode: partition the block pool over the "
+                        "mesh's data shards — each shard owns "
+                        "num_blocks/data blocks and slot tables index "
+                        "only their own shard's range")
+    p.add_argument("--num_replicas", type=int, default=defaults.num_replicas,
+                   help=">1 serves a fleet: N replica engines behind a "
+                        "load-aware router (requires --continuous)")
+    p.add_argument("--reload_poll_s", type=float,
+                   default=defaults.reload_poll_s,
+                   help="fleet hot reload: poll --checkpoint_dir every "
+                        "this many seconds and swap new steps in without "
+                        "dropping in-flight requests (0 = off)")
+    p.add_argument("--drain_timeout_s", type=float,
+                   default=defaults.drain_timeout_s,
+                   help="graceful-drain budget on SIGTERM/Ctrl-C: "
+                        "in-flight requests get this long to finish")
     p.add_argument("--temperature", type=float, default=defaults.temperature,
                    help="sampling temperature; 0 = greedy argmax (default)")
     p.add_argument("--top_k", type=int, default=defaults.top_k,
@@ -110,12 +136,23 @@ def parse_args(argv=None):
     return ServeArgs(**vars(p.parse_args(argv)))
 
 
+def _raise_interrupt(signum, frame):
+    # Funnel SIGTERM into the KeyboardInterrupt path the driver already
+    # handles: graceful drain instead of a hard kill.
+    raise KeyboardInterrupt
+
+
 def main(argv=None):
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s: %(message)s",
         force=True,
     )
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, _raise_interrupt)
+        except ValueError:
+            pass  # embedded interpreter without signal support
     from distributed_tensorflow_tpu.serve import run_serve
 
     result = run_serve(parse_args(argv))

@@ -1,0 +1,573 @@
+"""Fleet-serving tests: sharded block pools, the load-aware router,
+checkpoint polling + hot weight reload, and graceful drain.
+
+Layer by layer: ``BlockAllocator(num_shards=...)`` / ``PagedKVConfig``
+partitioning semantics (pure host), the ``FleetRouter`` dispatch contract
+against stubbed load signals (deterministic: shed only when ALL replicas
+reject, rejects retried on peers), ``CheckpointManager.poll()`` against a
+real orbax directory (fresh instance sees cross-manager saves; "no
+checkpoint yet" and "step regressed" paths via a scripted stub), then the
+real thing — a 2-replica fleet on the tiny CPU engine with greedy
+token-identical parity, a mid-run hot reload asserted via generation
+tags, per-shard KV pools on a data=2 mesh, and drain.
+"""
+
+import time
+import types
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+from distributed_tensorflow_tpu.models.gpt2 import PagedKVConfig
+from distributed_tensorflow_tpu.serve import (
+    BlockAllocator,
+    BlockExhaustedError,
+    CheckpointWatcher,
+    ContinuousScheduler,
+    DynamicBatcher,
+    FleetRouter,
+    Replica,
+    ServeEngine,
+    ServeOverloadedError,
+)
+from distributed_tensorflow_tpu.serve.fleet import replica_load_score
+
+
+def _reference(engine, prompt, max_new_tokens):
+    """Fixed-batch greedy answer for one prompt (row-independent), the
+    token-for-token target for anything the fleet serves."""
+    rows = engine.bucket_rows(1)
+    out = engine.generate(np.repeat(prompt[None, :], rows, axis=0),
+                          max_new_tokens)
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# Allocator / config layer: per-shard partitioning (pure host)
+# ---------------------------------------------------------------------------
+
+class TestShardedAllocator:
+    def test_partition_and_trash_blocks(self):
+        a = BlockAllocator(8, 4, num_shards=2)
+        assert a.blocks_per_shard == 4
+        assert a.capacity == 6  # one trash block reserved per shard
+        assert a.capacity_per_shard == 3
+        assert a.trash_block(0) == 0 and a.trash_block(1) == 4
+        assert a.shard_of(3) == 0 and a.shard_of(5) == 1
+
+    def test_allocate_stays_in_shard(self):
+        a = BlockAllocator(8, 4, num_shards=2)
+        got = a.allocate(3, shard=1)
+        assert set(got) <= {5, 6, 7}
+        # shard 1 exhausted even though shard 0 is entirely free
+        assert a.free_count_shard(0) == 3
+        with pytest.raises(BlockExhaustedError, match="in shard 1"):
+            a.allocate(1, shard=1)
+        a.free(got)
+        assert a.free_count_shard(1) == 3
+
+    def test_free_rejects_trash_and_double_free(self):
+        a = BlockAllocator(8, 4, num_shards=2)
+        with pytest.raises(ValueError, match="trash"):
+            a.free([4])
+        got = a.allocate(1, shard=0)
+        a.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(got)
+
+    def test_invalid_shard_counts(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            BlockAllocator(9, 4, num_shards=2)
+        with pytest.raises(ValueError, match="2 per shard"):
+            BlockAllocator(2, 4, num_shards=2)
+
+    def test_stats_reports_min_shard(self):
+        a = BlockAllocator(8, 4, num_shards=2)
+        a.allocate(3, shard=1)
+        s = a.stats()
+        assert s["num_shards"] == 2.0
+        assert s["blocks_free_min_shard"] == 0.0
+        assert s["blocks_free"] == 3.0
+
+
+class TestPagedKVConfigShards:
+    def test_per_shard_accounting(self):
+        p = PagedKVConfig(block_size=4, num_blocks=16, data_shards=2)
+        assert p.blocks_per_shard == 8
+        assert p.usable_blocks == 14
+        assert p.usable_blocks_per_shard == 7
+        assert p.trash_block(0) == 0 and p.trash_block(1) == 8
+
+    def test_invalid_combinations(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            PagedKVConfig(block_size=4, num_blocks=9, data_shards=2)
+        with pytest.raises(ValueError, match="fewer than 2"):
+            PagedKVConfig(block_size=4, num_blocks=2, data_shards=2)
+
+
+# ---------------------------------------------------------------------------
+# Router layer: deterministic dispatch against stubbed load signals
+# ---------------------------------------------------------------------------
+
+class _StubReplica:
+    """Replica-shaped stub: fixed load, optional shed, records submits."""
+
+    def __init__(self, replica_id, load=0.0, reject=False):
+        self.replica_id = replica_id
+        self.stub_load = load
+        self.reject = reject
+        self.submitted = []
+        self.engine = None
+        self.batcher = self
+        self.scheduler = self
+
+    def submit(self, payload):
+        if self.reject:
+            raise ServeOverloadedError("stub replica full")
+        self.submitted.append(payload)
+        fut = Future()
+        fut.rid = len(self.submitted)
+        fut.set_result(payload)
+        return fut
+
+    def stats(self):
+        return {"completed": float(len(self.submitted))}
+
+    def load(self):
+        return self.stub_load
+
+    def drain(self, timeout=30.0):
+        return True
+
+    def close(self, timeout=30.0):
+        pass
+
+
+class TestRouterDispatch:
+    def _router(self, reps):
+        return FleetRouter(reps, load_fn=lambda r: r.stub_load,
+                           name="fleet-stub")
+
+    def test_least_loaded_wins(self):
+        reps = [_StubReplica(0, load=2.0), _StubReplica(1, load=0.5),
+                _StubReplica(2, load=1.0)]
+        with self._router(reps) as router:
+            fut = router.submit("payload")
+            assert fut.replica == 1
+            assert reps[1].submitted == ["payload"]
+            assert not reps[0].submitted and not reps[2].submitted
+
+    def test_equal_load_breaks_toward_lowest_index(self):
+        reps = [_StubReplica(0), _StubReplica(1)]
+        with self._router(reps) as router:
+            assert router.submit("x").replica == 0
+
+    def test_reject_redispatches_to_next_least_loaded(self):
+        reps = [_StubReplica(0, load=0.0, reject=True),
+                _StubReplica(1, load=1.0)]
+        with self._router(reps) as router:
+            fut = router.submit("x")
+            assert fut.replica == 1
+            s = router.stats()
+            assert s["redispatched"] == 1.0
+            assert s["shed"] == 0.0
+            assert s["dispatch_replica_1"] == 1.0
+
+    def test_shed_only_when_all_replicas_reject(self):
+        reps = [_StubReplica(0, reject=True), _StubReplica(1, reject=True)]
+        with self._router(reps) as router:
+            with pytest.raises(ServeOverloadedError, match="all 2 replicas"):
+                router.submit("x")
+            assert router.stats()["shed"] == 1.0
+
+    def test_closed_router_rejects(self):
+        router = self._router([_StubReplica(0)])
+        router.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            router.submit("x")
+
+    def test_needs_a_replica(self):
+        with pytest.raises(ValueError, match="at least one replica"):
+            FleetRouter([])
+
+    def test_load_score_orders_pressure(self):
+        idle = replica_load_score({"queue_depth": 0, "capacity": 8,
+                                   "active_slots": 0, "num_slots": 8,
+                                   "blocks_total": 10, "blocks_free": 10})
+        busy = replica_load_score({"queue_depth": 0, "capacity": 8,
+                                   "active_slots": 8, "num_slots": 8,
+                                   "blocks_total": 10, "blocks_free": 2})
+        backlogged = replica_load_score({"queue_depth": 8, "capacity": 8,
+                                         "active_slots": 8, "num_slots": 8,
+                                         "blocks_total": 10,
+                                         "blocks_free": 0})
+        assert idle < busy < backlogged
+        # a full queue outranks a full pool by construction
+        assert replica_load_score({"queue_depth": 8, "capacity": 8}) > \
+            replica_load_score({"blocks_total": 10, "blocks_free": 0,
+                                "active_slots": 8, "num_slots": 8})
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint layer: poll() + the watcher's decision table
+# ---------------------------------------------------------------------------
+
+class TestCheckpointPoll:
+    def test_poll_none_then_sees_cross_manager_saves(self, tmp_path):
+        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        state = {"params": {"w": np.ones((2, 2), np.float32)}}
+        with CheckpointManager(d) as writer:
+            assert writer.poll() is None  # no checkpoint yet
+            writer.save(1, state)
+            writer.wait_until_finished()
+            assert writer.poll() == 1
+            # A SECOND manager instance (the watcher's situation: the
+            # trainer wrote the step) must see it despite orbax's step
+            # cache, and must keep up with later saves too.
+            with CheckpointManager(d) as reader:
+                assert reader.poll() == 1
+                writer.save(2, state)
+                writer.wait_until_finished()
+                assert reader.poll() == 2
+        closed = CheckpointManager(d)
+        closed.close()
+        assert closed.poll() is None
+
+
+class _StubManager:
+    """Scripted poll() sequence; records which steps were restored."""
+
+    def __init__(self, steps, params="host-params"):
+        self.steps = list(steps)
+        self.params = params
+        self.restored = []
+
+    def poll(self):
+        return self.steps.pop(0) if self.steps else None
+
+    def restore_params(self, step):
+        self.restored.append(step)
+        return self.params, {}
+
+    def close(self):
+        self.closed = True
+
+
+class _StubWatchReplica:
+    """Engine/scheduler surface the watcher touches, nothing else."""
+
+    def __init__(self, restored_step=None):
+        self.updates = []
+        self.engine = types.SimpleNamespace(
+            restored_step=restored_step, params=None,
+            shard_params=lambda p: ("sharded", p))
+        stub = self
+
+        class _Sched:
+            def update_params(self, params, *, generation):
+                stub.updates.append((generation, params))
+
+        self.scheduler = _Sched()
+
+
+class TestCheckpointWatcher:
+    def test_reload_regression_and_dedup(self):
+        mgr = _StubManager([5, 3, None, 5, 7])
+        reps = [_StubWatchReplica(), _StubWatchReplica()]
+        watcher = CheckpointWatcher(mgr, reps, start=False,
+                                    owns_manager=True)
+        assert watcher.generation == -1  # nothing restored yet
+        assert watcher.poll_once() == 5      # new step -> reload
+        assert watcher.poll_once() is None   # 3 < 5: regressed, keep 5
+        assert watcher.poll_once() is None   # no checkpoint visible
+        assert watcher.poll_once() is None   # same step: nothing to do
+        assert watcher.poll_once() == 7
+        assert mgr.restored == [5, 7]  # ONE restore per new step
+        assert watcher.generation == 7 and watcher.reloads == 2
+        for rep in reps:
+            assert [g for g, _ in rep.updates] == [5, 7]
+            # params went through the replica's own shard_params and the
+            # engine's reference moved forward with them
+            assert rep.engine.params == ("sharded", "host-params")
+        watcher.close()
+        assert mgr.closed
+
+    def test_restored_step_seeds_last_step(self):
+        mgr = _StubManager([3])
+        watcher = CheckpointWatcher(
+            mgr, [_StubWatchReplica(restored_step=3)], start=False)
+        # the engines already serve step 3: polling it again is a no-op
+        assert watcher.poll_once() is None
+        assert mgr.restored == []
+        watcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet on the real engine: parity, hot reload, drain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def eng_dp(request):
+    mesh_dp = request.getfixturevalue("mesh_dp")
+    eng = ServeEngine("gpt2", mesh=mesh_dp, preset="tiny", seed=0)
+    yield eng
+    eng.close()
+
+
+def _mixed(vocab, n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, vocab, size=((4, 6, 9)[i % 3],),
+                          dtype=np.int32), (2, 5, 3, 7)[i % 4])
+            for i in range(n)]
+
+
+class TestFleetParityAndReload:
+    def test_fleet_greedy_parity_with_spillover(self, eng_dp):
+        """Acceptance (a): greedy fleet output token-identical to the
+        single engine, across BOTH replicas.  Tight queues force real
+        spillover (rejects retried on the peer)."""
+        reqs = _mixed(eng_dp.module.cfg.vocab_size, 12)
+        scheds = [ContinuousScheduler(eng_dp, num_slots=8, max_total_len=32,
+                                      max_queue_size=2,
+                                      name=f"fleet-parity-r{i}")
+                  for i in range(2)]
+        replicas = [Replica(i, eng_dp, s) for i, s in enumerate(scheds)]
+        with FleetRouter(replicas, name="fleet-parity") as router:
+            futs = []
+            for prompt, m in reqs:
+                while True:
+                    try:
+                        futs.append(router.submit((prompt, m)))
+                        break
+                    except ServeOverloadedError:
+                        time.sleep(0.005)
+            results = [f.result(timeout=120.0) for f in futs]
+            for (prompt, m), toks, fut in zip(reqs, results, futs):
+                np.testing.assert_array_equal(
+                    np.asarray(toks), _reference(eng_dp, prompt, m)[:m])
+                assert fut.replica in (0, 1)
+                assert fut.generation == 0
+            stats = router.stats()
+            assert stats["completed"] == len(reqs)
+            assert stats["failed"] == 0.0
+            # queue pressure actually spread the work
+            assert stats["dispatch_replica_0"] > 0
+            assert stats["dispatch_replica_1"] > 0
+            assert (stats["dispatch_replica_0"] + stats["dispatch_replica_1"]
+                    == len(reqs))
+
+    def test_hot_reload_mid_run(self, eng_dp, tmp_path):
+        """Acceptance (b): reload while requests are in flight — zero
+        dropped, in-flight finish on the OLD generation (generation tags),
+        new admissions pin the new one, and identical saved weights give
+        token-identical output across generations."""
+        from distributed_tensorflow_tpu.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as writer:
+            writer.save(1, {"params": jax.device_get(eng_dp.params)})
+            writer.wait_until_finished()
+
+        scheds = [ContinuousScheduler(eng_dp, num_slots=8, max_total_len=64,
+                                      name=f"fleet-reload-r{i}")
+                  for i in range(2)]
+        replicas = [Replica(i, eng_dp, s) for i, s in enumerate(scheds)]
+        watcher = CheckpointWatcher(CheckpointManager(d), replicas,
+                                    start=False, owns_manager=True)
+        with FleetRouter(replicas, watcher=watcher,
+                         name="fleet-reload") as router:
+            rng = np.random.default_rng(3)
+            prompts = [rng.integers(0, eng_dp.module.cfg.vocab_size,
+                                    size=(6,), dtype=np.int32)
+                       for _ in range(4)]
+            futs_a = [router.submit((p, 48)) for p in prompts]
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                snaps = [s.stats() for s in scheds]
+                if (sum(s["active_slots"] for s in snaps) >= len(futs_a)
+                        and all(s["queue_depth"] == 0 for s in snaps)):
+                    break
+                time.sleep(0.002)
+            else:
+                pytest.fail("batch A never became resident")
+
+            assert watcher.poll_once() == 1  # hot swap staged mid-run
+            futs_b = [router.submit((p, 48)) for p in prompts]
+
+            res_a = [f.result(timeout=120.0) for f in futs_a]
+            res_b = [f.result(timeout=120.0) for f in futs_b]
+            # zero dropped/failed across the swap
+            stats = router.stats()
+            assert stats["failed"] == 0.0
+            assert stats["completed"] == len(futs_a) + len(futs_b)
+            # in-flight requests kept their admission generation; new
+            # admissions pinned the reloaded step
+            assert all(f.generation == 0 for f in futs_a)
+            assert all(f.generation == 1 for f in futs_b)
+            assert all(s.generation == 1 for s in scheds)
+            assert stats["param_generation"] == 1.0
+            # identical params across generations => identical tokens
+            for a, b in zip(res_a, res_b):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert watcher.generation == 1 and watcher.reloads == 1
+
+    def test_update_params_on_closed_scheduler_raises(self, eng_dp):
+        sched = ContinuousScheduler(eng_dp, num_slots=8, max_total_len=32,
+                                    name="fleet-closed")
+        sched.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            sched.update_params(eng_dp.params, generation=9)
+
+
+class TestDrain:
+    def test_drain_finishes_resident_sheds_queued(self, eng_dp):
+        sched = ContinuousScheduler(eng_dp, num_slots=8, max_total_len=48,
+                                    name="fleet-drain")
+        batcher = DynamicBatcher(iteration_level=True, scheduler=sched)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, eng_dp.module.cfg.vocab_size, size=(4,),
+                                dtype=np.int32) for _ in range(10)]
+        futs = [batcher.submit((p, 40)) for p in prompts]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if sched.stats()["active_slots"] == 8:
+                break
+            time.sleep(0.002)
+        else:
+            pytest.fail("slots never filled")
+
+        assert batcher.drain(60.0) is True
+        resolved = shed = 0
+        for f in futs:
+            assert f.done()
+            try:
+                assert len(f.result(timeout=0.0)) == 40
+                resolved += 1
+            except ServeOverloadedError:
+                shed += 1
+        assert resolved == 8 and shed == 2
+        # post-drain submissions shed instead of hanging
+        with pytest.raises(ServeOverloadedError, match="draining"):
+            batcher.submit((prompts[0], 4))
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-shard KV pools on a data=2 mesh
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def eng_2dev(devices8):
+    mesh = build_mesh(MeshConfig(data=2), devices8[:2])
+    eng = ServeEngine("gpt2", mesh=mesh, preset="tiny", seed=0)
+    yield eng
+    eng.close()
+
+
+class TestPerShardPools:
+    COMMON = dict(num_slots=4, max_total_len=32, cache_mode="paged",
+                  block_size=4, num_blocks=20)
+
+    def test_parity_under_cross_shard_demand(self, eng_2dev):
+        """Acceptance (c): per-shard pools on data=2 serving total demand
+        bigger than ONE shard's pool, token-identical to the reference."""
+        sched = ContinuousScheduler(eng_2dev, per_shard_kv=True,
+                                    name="pershard", **self.COMMON)
+        try:
+            # slots partition contiguously over the shards; untouched
+            # table rows point at their OWN shard's trash block
+            assert sched._slot_shard == [0, 0, 1, 1]
+            assert sched._allocator.trash_block(1) == 10
+            assert (sched._block_tables[2:] == 10).all()
+            assert (sched._block_tables[:2] == 0).all()
+
+            rng = np.random.default_rng(11)
+            reqs = [(rng.integers(0, eng_2dev.module.cfg.vocab_size,
+                                  size=(8,), dtype=np.int32), 16)
+                    for _ in range(8)]
+            futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+            for (prompt, m), fut in zip(reqs, futs):
+                np.testing.assert_array_equal(
+                    np.asarray(fut.result(timeout=120.0)),
+                    _reference(eng_2dev, prompt, m)[:m])
+            stats = sched.stats()
+            assert stats["failed"] == 0.0
+            # both shards ran concurrently: peak block demand exceeded
+            # what one shard's pool could ever hold
+            assert stats["blocks_high_water"] > \
+                sched._allocator.capacity_per_shard
+            assert stats["blocks_free"] == float(sched._allocator.capacity)
+        finally:
+            sched.close()
+
+    def test_per_shard_halves_resident_bytes(self, eng_2dev):
+        """Same pool size, same GLOBAL bytes — but each shard holds only
+        its own half instead of a full replica."""
+        sharded = ContinuousScheduler(eng_2dev, per_shard_kv=True,
+                                      start=False, name="pershard-mem",
+                                      **self.COMMON)
+        replicated = ContinuousScheduler(eng_2dev, per_shard_kv=False,
+                                         start=False, name="replpool-mem",
+                                         **self.COMMON)
+        try:
+            assert sharded.kv_hbm_bytes == replicated.kv_hbm_bytes
+            assert sharded.kv_hbm_bytes_per_shard <= \
+                0.55 * replicated.kv_hbm_bytes_per_shard
+            assert sharded.stats()["kv_hbm_bytes_per_shard"] == \
+                float(sharded.kv_hbm_bytes_per_shard)
+        finally:
+            sharded.close()
+            replicated.close()
+
+    def test_pool_too_small_for_one_shard_rejected(self, eng_2dev):
+        # 16 blocks over 2 shards = 7 usable each < the 8 blocks one
+        # max-length request needs: rejected at construction, per shard
+        with pytest.raises(ValueError, match="usable blocks per data shard"):
+            ContinuousScheduler(eng_2dev, per_shard_kv=True, start=False,
+                                name="pershard-tiny", num_slots=4,
+                                max_total_len=32, cache_mode="paged",
+                                block_size=4, num_blocks=16)
+
+    def test_data_shards_must_match_mesh(self, eng_dp):
+        with pytest.raises(ValueError, match="data-parallel extent"):
+            eng_dp.init_paged_cache(
+                8, 32, paged=PagedKVConfig(block_size=4, num_blocks=66,
+                                           data_shards=2))
+
+    def test_per_shard_requires_paged(self, eng_2dev):
+        with pytest.raises(ValueError, match="cache_mode='paged'"):
+            ContinuousScheduler(eng_2dev, per_shard_kv=True, start=False,
+                                name="pershard-dense", num_slots=4,
+                                max_total_len=32)
+
+
+# ---------------------------------------------------------------------------
+# Driver: run_serve with a 2-replica fleet
+# ---------------------------------------------------------------------------
+
+class TestFleetDriver:
+    def test_run_serve_fleet_smoke(self, eng_dp):
+        from distributed_tensorflow_tpu.serve import ServeArgs, run_serve
+
+        args = ServeArgs(model="gpt2", preset="tiny", continuous=True,
+                         num_replicas=2, steps=8, clients=2, prompt_len=6,
+                         max_new_tokens=4, num_slots=8, log_every=4)
+        out = run_serve(args, engine=eng_dp)
+        assert out["num_replicas"] == 2
+        assert out["completed"] == 8
+        assert sum(out["fleet_dispatch"]) == 8
+        assert out["fleet_shed"] == 0
+        assert out["param_generation"] == 0
+        assert out["tokens_generated"] == 8 * 4
+
+    def test_fleet_requires_continuous(self, eng_dp):
+        from distributed_tensorflow_tpu.serve import ServeArgs, run_serve
+
+        with pytest.raises(ValueError, match="num_replicas"):
+            run_serve(ServeArgs(model="gpt2", preset="tiny", steps=2,
+                                num_replicas=2), engine=eng_dp)

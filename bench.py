@@ -122,7 +122,14 @@ def _serve_bench(flags):
     of the dense cache's token capacity — the few long prompts in the
     skewed mix no longer force every slot to carry a max-length row.
     ``paged_speedup`` and the ``kv_hbm_ratio_*`` keys carry the
-    throughput-parity and memory-savings claims."""
+    throughput-parity and memory-savings claims.
+
+    A final cold/warm pair replays shared-prefix traffic through the
+    paged scheduler with prefix caching off then on:
+    ``prefix_hit_rate``, ``prefill_tokens_skipped`` and
+    ``ttft_speedup_prefix`` carry the prefix-caching claim, and
+    ``prefix_parity`` asserts the warm run's greedy token checksum is
+    identical to the cold run's."""
     import dataclasses
 
     import jax
@@ -188,12 +195,23 @@ def _serve_bench(flags):
     # process, so no throughput claim on CPU — the line carries the
     # dispatch spread and shed count as the router's smoke evidence.
     fleet = dataclasses.replace(continuous, num_replicas=2)
+    # Prefix-caching A/B: the same shared-prefix traffic (every prompt
+    # carries one of 2 long system prompts) through the paged scheduler
+    # cold (cache off) then warm (cache on).  num_blocks=0 gives both
+    # runs full capacity so the TTFT delta measures prefill skipped, not
+    # admission backpressure; greedy checksums must match bit-for-bit.
+    prefix_cold = dataclasses.replace(
+        paged, num_blocks=0, prefix_cache=False,
+        shared_prefix_len=256 if on_tpu else 64, shared_prefix_groups=2)
+    prefix_warm = dataclasses.replace(prefix_cold, prefix_cache=True)
     try:
         fixed_res = run_serve(fixed, engine=engine)
         cont_res = run_serve(continuous, engine=engine)
         paged_res = run_serve(paged, engine=engine)
         int8_res = run_serve(paged_int8, engine=engine)
         fleet_res = run_serve(fleet, engine=engine)
+        prefix_cold_res = run_serve(prefix_cold, engine=engine)
+        prefix_warm_res = run_serve(prefix_warm, engine=engine)
     finally:
         engine.close()
     trace_events = len(tracer)
@@ -249,6 +267,15 @@ def _serve_bench(flags):
         "fleet_replicas": fleet_res["num_replicas"],
         "fleet_dispatch": fleet_res["fleet_dispatch"],
         "fleet_shed": fleet_res["fleet_shed"],
+        "prefix_hit_rate": prefix_warm_res["prefix_hit_rate"],
+        "prefill_tokens_skipped": prefix_warm_res["prefill_tokens_skipped"],
+        "prefix_ttft_p50_ms": prefix_warm_res["ttft_p50_ms"],
+        "prefix_cold_ttft_p50_ms": prefix_cold_res["ttft_p50_ms"],
+        "ttft_speedup_prefix": round(
+            prefix_cold_res["ttft_p50_ms"]
+            / max(prefix_warm_res["ttft_p50_ms"], 1e-9), 3),
+        "prefix_parity": (prefix_warm_res["tokens_checksum"]
+                          == prefix_cold_res["tokens_checksum"]),
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

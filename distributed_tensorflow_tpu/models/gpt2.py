@@ -179,6 +179,15 @@ class PagedKVConfig:
         """Physical blocks covering ``tokens`` logical positions."""
         return -(-max(0, tokens) // self.block_size)
 
+    def prefix_blocks(self, prompt_len: int) -> int:
+        """Most leading blocks of a ``prompt_len``-token prompt that
+        prefix caching may map from cache: full blocks only, and never
+        the whole prompt — prefill must compute at least the final
+        position to emit the first sampled token, so a block-aligned
+        prompt re-computes its last block into a private (copy-on-write)
+        block instead of mapping it."""
+        return max(0, int(prompt_len) - 1) // self.block_size
+
     def max_blocks_per_slot(self, total_len: int) -> int:
         return self.blocks_for(total_len)
 
@@ -374,6 +383,13 @@ class Block(nn.Module):
         compute dtype and ``max_blocks * block_size == max_total_len``,
         the post-gather math is shape-identical to the dense slot path —
         greedy streams match it token for token.
+
+        Prefix caching rides on this unchanged: a suffix prefill arrives
+        with ``cache_index`` preset to the block-aligned start, so the
+        scatter only writes positions ``>= start`` (shared prefix blocks
+        are never touched) while the gather still pulls the slot's WHOLE
+        table row — the mapped cached blocks below ``start`` — and the
+        ``k_pos <= q_pos`` causal mask admits them for every query.
         """
         cfg, pg = self.cfg, self.paged
         B, T, h, head_dim = q.shape

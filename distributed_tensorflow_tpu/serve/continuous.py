@@ -47,6 +47,15 @@ Fleet extensions (``serve/fleet``):
 - GRACEFUL DRAIN — ``drain()`` stops admissions (submit sheds with
   ``ServeOverloadedError``), fails the queued-but-unadmitted backlog, and
   waits for every resident slot to finish before the caller ``close()``s.
+- PREFIX CACHING — ``prefix_cache=True`` (paged mode) maps a new
+  request's longest cached prompt prefix straight into its block table
+  (refcounted shares of blocks other slots already filled; see
+  ``serve/paged.py`` for the chained-hash/COW invariants) and prefills
+  only from the first uncached token via the engine's ``start_offsets``
+  path — admission skips the shared prefix's compute AND its HBM.
+  Composes with per-shard pools (each shard keys its own map — slots
+  only index local blocks) and hot reload (the map is invalidated at
+  generation install: cached K/V is params-dependent).
 """
 
 from __future__ import annotations
@@ -69,7 +78,10 @@ from distributed_tensorflow_tpu.serve.batcher import (
     _percentile,
     _serve_instruments,
 )
-from distributed_tensorflow_tpu.serve.paged import BlockAllocator
+from distributed_tensorflow_tpu.serve.paged import (
+    BlockAllocator,
+    chain_block_keys,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +105,16 @@ def _continuous_instruments(registry=None):
             "dtt_serve_request_seconds", "Submit to retirement"),
         "active_slots": r.gauge(
             "dtt_serve_active_slots", "Slots currently decoding"),
+        "prefix_hits": r.counter(
+            "dtt_kv_prefix_hits_total",
+            "Cacheable prompt blocks served from the prefix cache"),
+        "prefix_misses": r.counter(
+            "dtt_kv_prefix_misses_total",
+            "Cacheable prompt blocks that had to be prefilled"),
+        "prefix_skipped": r.histogram(
+            "dtt_kv_prefix_prefill_tokens_skipped",
+            "Prompt tokens whose prefill compute a cache hit skipped",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)),
     })
     return out
 
@@ -122,6 +144,9 @@ class _SlotRequest:
     # Hot reload: the param generation pinned at admission (the request
     # decodes on these weights even if a newer generation lands mid-flight).
     gen: Optional["_ParamGeneration"] = None
+    # Prefix caching: the prompt's chained block content keys, computed
+    # once on the submitting thread (pure hashing — no allocator state).
+    prefix_keys: List[bytes] = dataclasses.field(default_factory=list)
 
     def done(self) -> bool:
         if len(self.tokens) >= self.max_new_tokens:
@@ -179,6 +204,7 @@ class ContinuousScheduler:
         num_blocks: Optional[int] = None,
         kv_dtype: Optional[str] = None,
         per_shard_kv: bool = False,
+        prefix_cache: bool = False,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -198,7 +224,12 @@ class ContinuousScheduler:
             raise ValueError(
                 "per_shard_kv partitions the paged block pool — it "
                 "requires cache_mode='paged'")
+        if prefix_cache and cache_mode != "paged":
+            raise ValueError(
+                "prefix_cache shares physical KV blocks through block "
+                "tables — it requires cache_mode='paged'")
         self.engine = engine
+        self.prefix_cache = bool(prefix_cache)
         self.num_slots = engine.bucket_rows(max(1, num_slots))
         self.max_total_len = int(max_total_len or cfg.n_positions)
         self.max_queue_size = max_queue_size
@@ -287,6 +318,11 @@ class ContinuousScheduler:
         self._failed = 0
         self._admitted = 0
         self._retired = 0
+        # Prefix caching (under _lock): cacheable-block hit/miss totals
+        # and prompt tokens whose prefill compute cache hits skipped.
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_tokens_skipped = 0
         self._iterations = 0
         self._decode_counter = 0  # fold_in counter for the in-step RNG
         self._occupancy_sum = 0
@@ -349,6 +385,10 @@ class ContinuousScheduler:
             prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token=self.eos_token if eos_token is None else eos_token,
             future=Future(), submitted=time.monotonic())
+        if self.prefix_cache:
+            # Hash the prompt's full blocks HERE on the client thread —
+            # pure compute, so the loop thread only ever walks the map.
+            req.prefix_keys = chain_block_keys(prompt, self.block_size)
         with self._cond:
             if self._stopped:
                 raise RuntimeError("ContinuousScheduler is closed")
@@ -490,6 +530,7 @@ class ContinuousScheduler:
             tpot = self._tpot_ms
             qw = sorted(self._queue_wait_ms)
             iters = self._iterations
+            prefix_lookups = self._prefix_hits + self._prefix_misses
             return {
                 **self._block_stats(),
                 "queue_depth": float(len(self._queue)),
@@ -519,6 +560,12 @@ class ContinuousScheduler:
                 "queue_wait_p50_ms": _percentile(qw, 0.50),
                 "queue_wait_p99_ms": _percentile(qw, 0.99),
                 "param_generation": float(self._gen.generation),
+                "prefix_hits": float(self._prefix_hits),
+                "prefix_misses": float(self._prefix_misses),
+                "prefix_hit_rate": (self._prefix_hits / prefix_lookups
+                                    if prefix_lookups else 0.0),
+                "prefill_tokens_skipped": float(
+                    self._prefix_tokens_skipped),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -557,6 +604,7 @@ class ContinuousScheduler:
         try:
             while True:
                 admits: List[_SlotRequest] = []
+                gen_swapped = False
                 with self._cond:
                     while (not self._stopped and not self._active
                            and not self._queue
@@ -570,6 +618,7 @@ class ContinuousScheduler:
                         # active keep their own generation's params.
                         old, self._gen = self._gen, self._pending_gen
                         self._pending_gen = None
+                        gen_swapped = True
                         if old.refs == 0:
                             old.params = None  # nothing in flight holds it
                         logger.info(
@@ -602,6 +651,17 @@ class ContinuousScheduler:
                         # start its reservation-wait span.
                         self._queue[0].blocked_since = time.monotonic()
                     self._obs["depth"].set(len(self._queue))
+                if gen_swapped and self.prefix_cache:
+                    # Cached K/V is a function of the weights that wrote
+                    # it: a new generation drops every key (before this
+                    # iteration's admissions, which pin the new params).
+                    # In-flight shares keep their refcounts and free
+                    # normally at retirement.
+                    dropped = self._allocator.invalidate_prefix_cache()
+                    if dropped:
+                        logger.info(
+                            "hot reload invalidated %d prefix-cached "
+                            "block(s)", dropped)
                 self._admit(admits)
                 self._decode_once()
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
@@ -636,7 +696,11 @@ class ContinuousScheduler:
         best, best_headroom = None, need - 1
         for i in range(len(self._free) - 1, -1, -1):
             sh = self._slot_shard[self._free[i]]
+            # Zero-ref prefix-cached blocks count as headroom: allocate()
+            # evicts them LRU-first, so caching never steals admission
+            # capacity from live requests.
             headroom = (self._allocator.free_count_shard(sh)
+                        + self._allocator.evictable_count_shard(sh)
                         - self._reserved[sh])
             if headroom > best_headroom:
                 best, best_headroom = i, headroom
@@ -668,6 +732,60 @@ class ContinuousScheduler:
             return {}
         return {"paged": self.paged, "block_tables": self._block_tables}
 
+    def _map_prefix(self, req: _SlotRequest) -> int:
+        """Map the longest cached prefix into ``req``'s slot (loop thread,
+        outside the lock — same discipline as ``_ensure_blocks``).  Bumps
+        the hit blocks' refcounts, writes them into the slot's table row,
+        releases the matching admission reservations, and returns the
+        block-aligned position prefill starts from (0 on a miss).
+
+        The chain is re-walked HERE, at map time, not trusted from any
+        earlier peek: an eviction between pick and map (another admit in
+        the same batch allocating under pressure) must shorten the hit,
+        never resurrect a reallocated block."""
+        if not self.prefix_cache or not req.prefix_keys:
+            return 0
+        # Never map the whole prompt: prefill must compute >= 1 position,
+        # so a block-aligned prompt recomputes its last block (COW).
+        cacheable = self.paged.prefix_blocks(len(req.prompt))
+        if cacheable <= 0:
+            return 0
+        shard = self._slot_shard[req.slot]
+        blocks = self._allocator.acquire_prefix(
+            req.prefix_keys[:cacheable], shard)
+        m = len(blocks)
+        if m:
+            self._block_tables[req.slot, :m] = blocks
+            self._slot_blocks[req.slot].extend(blocks)
+        start = m * self.block_size
+        with self._lock:
+            self._prefix_hits += m
+            self._prefix_misses += cacheable - m
+            if m:
+                release = min(req.reserved_blocks, m)
+                req.reserved_blocks -= release
+                self._reserved[shard] -= release
+                self._prefix_tokens_skipped += start
+                self._obs["prefix_hits"].inc(m)
+                self._obs["prefix_skipped"].observe(start)
+            if cacheable - m:
+                self._obs["prefix_misses"].inc(cacheable - m)
+        return start
+
+    def _register_prefix(self, req: _SlotRequest) -> None:
+        """After prefill: publish the slot's FULL prompt blocks (now
+        holding their final K/V — decode appends strictly past the
+        prompt) under their chained keys.  Idempotent for the blocks that
+        were themselves mapped from cache."""
+        if not self.prefix_cache or not req.prefix_keys:
+            return
+        full = len(req.prompt) // self.block_size
+        if full <= 0:
+            return
+        self._allocator.register_prefix(
+            self._slot_blocks[req.slot][:full], req.prefix_keys[:full],
+            self._slot_shard[req.slot])
+
     def _admit(self, admits: List[_SlotRequest]) -> None:
         """Slot-local prefill per admitted request.  Prompts are prefilled
         one request at a time — each (1, T_prompt) program compiles once
@@ -687,22 +805,26 @@ class ContinuousScheduler:
                         start=req.blocked_since, end=prefill_start,
                         args={"request_id": req.rid,
                               "reserved_blocks": req.reserved_blocks})
+            start = self._map_prefix(req)
             self._ensure_blocks(req, len(req.prompt))
             tok_dev, self._cache = self.engine.prefill_into_slots(
-                self._cache, req.prompt[None, :], [req.slot],
+                self._cache, req.prompt[None, start:], [req.slot],
                 temperature=self.temperature, top_k=self.top_k,
                 counter=self._next_counter(), params=req.gen.params,
+                start_offsets=[start] if start else None,
                 **self._paged_call_kwargs())
             tok = int(np.asarray(jax.device_get(tok_dev))[0])
             req.first_token_at = time.monotonic()
             req.tokens.append(tok)
             self._last_tok[req.slot, 0] = tok
+            self._register_prefix(req)
             if self._tracer.enabled:
                 self._tracer.add_span(
                     "prefill", cat="serve", tid=req.rid,
                     start=prefill_start, end=req.first_token_at,
                     args={"request_id": req.rid, "slot": req.slot,
-                          "prompt_len": int(len(req.prompt))})
+                          "prompt_len": int(len(req.prompt)),
+                          "prefix_tokens_cached": int(start)})
             with self._lock:
                 self._admitted += 1
                 self._active[req.slot] = req

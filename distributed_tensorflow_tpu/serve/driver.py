@@ -32,6 +32,7 @@ occupancy, straight from the scheduler's counters.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import threading
 import time
@@ -90,6 +91,17 @@ class ServeArgs:
     # shard owns num_blocks/data blocks and slot tables index only their
     # own shard's range (requires cache_mode="paged").
     per_shard_kv: bool = False
+    # Content-addressed prefix caching (requires cache_mode="paged"):
+    # requests whose prompt shares full leading blocks with an earlier
+    # request map those blocks from cache (refcounted, copy-on-write)
+    # and prefill only the uncached suffix.
+    prefix_cache: bool = False
+    # Shared-prefix traffic mix: >0 prepends a system prompt of this many
+    # tokens to every request, drawn from `shared_prefix_groups` distinct
+    # prefixes — the workload prefix caching exists for.  0 keeps the
+    # fully-random mix.
+    shared_prefix_len: int = 0
+    shared_prefix_groups: int = 2
     # fleet (serve/fleet/): >1 runs N replica engines behind a
     # load-aware FleetRouter (requires --continuous on gpt2).
     num_replicas: int = 1
@@ -146,6 +158,7 @@ def _cache_kwargs(args: ServeArgs) -> Dict[str, Any]:
         "num_blocks": args.num_blocks or None,
         "kv_dtype": args.kv_dtype or None,
         "per_shard_kv": args.per_shard_kv,
+        "prefix_cache": args.prefix_cache,
     }
 
 
@@ -164,12 +177,24 @@ def _make_requests(args: ServeArgs, engine: ServeEngine,
         vocab = engine.module.cfg.vocab_size
         lens = _prompt_lengths(args)
         horizons = _horizons(args)
-        return [
-            (rng.integers(0, vocab, size=(lens[i % len(lens)],),
-                          dtype=np.int32),
-             horizons[i % len(horizons)])
-            for i in range(args.steps)
-        ]
+        # Shared-prefix mix: request i carries system prompt i % K plus
+        # its own random tail of the cycled length — the distinct-prefix
+        # groups are what the prefix cache's hit rate is measured over.
+        prefixes = None
+        if args.shared_prefix_len > 0:
+            prefixes = [
+                rng.integers(0, vocab, size=(args.shared_prefix_len,),
+                             dtype=np.int32)
+                for _ in range(max(1, args.shared_prefix_groups))]
+        payloads = []
+        for i in range(args.steps):
+            tail = rng.integers(0, vocab, size=(lens[i % len(lens)],),
+                                dtype=np.int32)
+            prompt = (tail if prefixes is None
+                      else np.concatenate([prefixes[i % len(prefixes)],
+                                           tail]))
+            payloads.append((prompt, horizons[i % len(horizons)]))
+        return payloads
     batch = next(engine.workload.data_fn(max(2, args.max_batch_size)))
     n = len(next(iter(batch.values())))
     return [{k: np.asarray(v[i % n]) for k, v in batch.items()
@@ -316,17 +341,38 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
                                              args.max_batch_size)])
         return
     if args.continuous:
+        # The warm scheduler runs with the prefix cache OFF: the jitted
+        # prefill program depends only on the token-suffix LENGTH (the
+        # start offset is a dynamic argument), so a full-length prefill
+        # of T tokens compiles exactly the program a cached request with
+        # a T-token uncached suffix will launch.
+        warm_kwargs = {**_cache_kwargs(args), "prefix_cache": False} \
+            if args.cache_mode == "paged" else _cache_kwargs(args)
         warm_sched = ContinuousScheduler(
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
                               max(p.shape[0] + m for p, m in payloads)),
             temperature=args.temperature, top_k=args.top_k,
-            **_cache_kwargs(args))
-        futs = {}
-        for length in sorted({p.shape[0] for p, _ in payloads}):
-            prompt = next(p for p, _ in payloads if p.shape[0] == length)
-            futs[length] = warm_sched.submit(prompt, max_new_tokens=2)
-        for f in futs.values():
+            **warm_kwargs)
+        lengths = sorted({p.shape[0] for p, _ in payloads})
+        warm_lengths = set(lengths)
+        if args.prefix_cache and args.shared_prefix_len > 0:
+            # Suffix shapes the timed run will launch once each group's
+            # prefix is cached: total length minus the block-aligned
+            # cached-prefix depth.
+            aligned = (args.shared_prefix_len // args.block_size) \
+                * args.block_size
+            for length in lengths:
+                s = min(aligned,
+                        (length - 1) // args.block_size * args.block_size)
+                if 0 < s < length:
+                    warm_lengths.add(length - s)
+        futs = []
+        for length in sorted(warm_lengths):
+            donor = next(p for p, _ in payloads if p.shape[0] >= length)
+            futs.append(warm_sched.submit(donor[:length],
+                                          max_new_tokens=2))
+        for f in futs:
             f.result(timeout=600.0)
         warm_sched.close()
         return
@@ -457,6 +503,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["tpot_mean_ms"] = round(stats["tpot_mean_ms"], 4)
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
+        if args.cache_mode == "paged":
+            out["prefix_cache"] = bool(args.prefix_cache)
+        if args.prefix_cache:
+            out["prefix_hit_rate"] = round(stats["prefix_hit_rate"], 4)
+            out["prefill_tokens_skipped"] = int(
+                stats["prefill_tokens_skipped"])
+            out["prefix_cached_blocks"] = int(stats["prefix_cached_blocks"])
+            out["prefix_evictions"] = int(stats["prefix_evictions"])
         out["kv_hbm_bytes"] = int(stats["kv_hbm_bytes"])
         out["block_size"] = int(stats["block_size"])
         out["blocks_total"] = int(stats["blocks_total"])
@@ -479,6 +533,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         delivered = int(sum(len(r) for r in results))
         out["tokens_generated"] = delivered
         out["tokens_per_sec"] = round(delivered / max(elapsed, 1e-9), 2)
+        if not interrupted:
+            # Submission-order digest of every generated stream: two runs
+            # over the same traffic are token-identical iff these match
+            # (the prefix-cache parity oracle in bench/smoke).
+            h = hashlib.sha256()
+            for r in results:
+                h.update(np.asarray(r, np.int32).tobytes())
+            out["tokens_checksum"] = h.hexdigest()[:16]
         # Sanity surface for smoke tests: every delivered result honors
         # its horizon (a drained run only checks what actually finished).
         assert all(len(r) == m for r, (_, m) in zip(results, done_payloads))

@@ -392,16 +392,21 @@ class ServeEngine:
         return int(total)
 
     @staticmethod
-    def _reset_slot_rows(cache: PyTree, slot_ids) -> PyTree:
-        """Zero ``cache_index``/``position`` rows for ``slot_ids`` — slot
-        reuse hygiene: a freshly admitted request must not inherit the
-        previous occupant's offsets.  K/V rows need no zeroing: the causal
-        mask hides everything past the (reset) index, and prefill
-        overwrites from position 0."""
+    def _reset_slot_rows(cache: PyTree, slot_ids, starts) -> PyTree:
+        """Set ``cache_index``/``position`` rows for ``slot_ids`` to
+        ``starts`` — slot reuse hygiene: a freshly admitted request must
+        not inherit the previous occupant's offsets.  ``starts`` is 0 for
+        a classic full prefill; prefix caching passes each slot's
+        block-aligned first UNCACHED position so the suffix prefill
+        writes (and positions) from there, attending over the mapped
+        cached blocks below it.  K/V rows need no zeroing: the causal
+        mask hides everything past the reset index, and prefill
+        overwrites from ``start``."""
         def _one(path, leaf):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
             if name in ("cache_index", "position"):
-                return leaf.at[..., slot_ids].set(0)
+                return leaf.at[..., slot_ids].set(
+                    starts.astype(leaf.dtype))
             return leaf
 
         return jax.tree_util.tree_map_with_path(_one, cache)
@@ -412,8 +417,9 @@ class ServeEngine:
                 else {"paged": paged, "block_tables": block_tables})
 
     def _prefill_slots_apply(self, temperature, top_k, paged, params, cache,
-                             tokens, slot_ids, block_tables, rng, counter):
-        cache = self._reset_slot_rows(cache, slot_ids)
+                             tokens, slot_ids, block_tables, rng, counter,
+                             starts):
+        cache = self._reset_slot_rows(cache, slot_ids, starts)
         logits, mutated = self.module.apply(
             {"params": params, "cache": cache}, tokens,
             decode=True, slot_ids=slot_ids, mutable=["cache"],
@@ -426,7 +432,8 @@ class ServeEngine:
                            slot_ids: np.ndarray, *,
                            temperature: float = 0.0, top_k: int = 0,
                            rng=None, counter: int = 0,
-                           paged=None, block_tables=None, params=None):
+                           paged=None, block_tables=None, params=None,
+                           start_offsets=None):
         """Admit requests: slot-local prefill writing each prompt's K/V
         into its slot's rows of the RESIDENT cache (state rows reset
         first), returning (first generated tokens (n,), updated cache).
@@ -438,6 +445,13 @@ class ServeEngine:
         (num_slots, max_blocks_per_slot) int32 table, whose rows for
         ``slot_ids`` must already cover each prompt's blocks.
 
+        ``start_offsets`` (n,) starts each row's prefill at that logical
+        position instead of 0 (prefix caching: ``prompts`` then carries
+        only the UNCACHED suffix, and the slot's table rows below the
+        offset must already map the cached prefix blocks).  Offsets are
+        a dynamic argument — varying them never recompiles; only the
+        suffix LENGTH is a compile-time shape.
+
         ``params`` overrides ``self.params`` for this call (hot weight
         reload: the scheduler pins each request to the param generation it
         was admitted with).  Params are the NON-donated first argument of
@@ -448,6 +462,17 @@ class ServeEngine:
             raise ValueError(f"prompts must be (n, T), got {prompts.shape}")
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
+        starts = (np.zeros((prompts.shape[0],), np.int32)
+                  if start_offsets is None
+                  else np.asarray(start_offsets, np.int32))
+        if starts.shape != (prompts.shape[0],):
+            raise ValueError(
+                f"start_offsets must be ({prompts.shape[0]},), "
+                f"got {starts.shape}")
+        if starts.any() and paged is None:
+            raise ValueError(
+                "start_offsets > 0 requires the paged cache (prefix "
+                "blocks are mapped through the block table)")
         key = ("slot_prefill", float(temperature), int(top_k), paged)
         if key not in self._generate_fns:
             self._obs["compiles"].labels(kind="slot_prefill").inc()
@@ -462,7 +487,7 @@ class ServeEngine:
         with _launch_lock:
             out = self._generate_fns[key](
                 self.params if params is None else params, cache, prompts,
-                np.asarray(slot_ids, np.int32), bt, base, counter)
+                np.asarray(slot_ids, np.int32), bt, base, counter, starts)
         self._obs["prefill"].observe(time.perf_counter() - t0)
         return out
 

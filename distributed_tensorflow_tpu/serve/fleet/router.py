@@ -175,6 +175,7 @@ class FleetRouter:
         with self._lock:
             if self._closed:
                 raise RuntimeError("FleetRouter is closed")
+        t0 = time.monotonic()
         ranked = self._ranked()
         for rank, (score, idx, rep) in enumerate(ranked):
             try:
@@ -192,8 +193,8 @@ class FleetRouter:
             fut.replica = rep.replica_id
             if self._tracer.enabled:
                 self._tracer.add_span(
-                    "fleet_route", cat="fleet",
-                    tid=getattr(fut, "rid", 0),
+                    "fleet_route", start=t0, end=time.monotonic(),
+                    cat="fleet", tid=getattr(fut, "rid", 0),
                     args={"replica": rep.replica_id,
                           "attempts": rank + 1,
                           "load": round(score, 4)})

@@ -3,14 +3,15 @@
 The device side (``models.gpt2`` paged attention, ``ServeEngine``'s paged
 slot programs) is stateless about placement: every call receives the
 ``(num_slots, max_blocks_per_slot)`` block table as an argument.  THIS is
-where placement lives — a plain free-list allocator the
+where placement lives — a refcounted free-list allocator the
 ``ContinuousScheduler`` drives from its scheduling thread:
 
 - allocate-on-admit / on-boundary-cross: a slot asks for blocks lazily as
   its written length crosses ``block_size`` boundaries, so a request only
   ever pins the blocks it has actually filled;
-- bulk-free on retire: the slot's whole block list returns to the free
-  list in one call, and its table row resets to the trash block;
+- bulk-free on retire: ``free`` drops one reference per block; a block
+  returns to the pool only when its LAST holder releases it (prefix
+  sharing pins one physical block under several slots' tables);
 - LIFO reuse: just-freed blocks are handed out first (warm cache lines,
   and deterministic reuse for the stale-data hygiene tests).
 
@@ -28,11 +29,54 @@ device pool's block dimension is sharded over the data axis in the same
 order — a block id allocated from shard ``s`` physically lives on data
 shard ``s``'s devices, so a slot pinned to shard ``s`` only ever touches
 local HBM.  ``num_shards=1`` reduces exactly to the classic layout above.
+
+Prefix caching (chained-hash / copy-on-write invariants)
+--------------------------------------------------------
+
+``register_prefix`` publishes a slot's FULL prompt blocks into a
+content-addressed map so later requests sharing the prefix can map the
+same physical blocks instead of recomputing their K/V.  The invariants:
+
+- CHAINED KEYS: block ``i``'s key is
+  ``sha256(key_{i-1} || tokens[i*bs:(i+1)*bs])`` (``chain_block_keys``),
+  so a block's identity covers its entire prefix — two prompts that agree
+  on block 3 but diverged in block 1 can never alias.  Lookups walk the
+  chain and stop at the first miss, which makes every cache hit a
+  LONGEST-PREFIX hit by construction.
+- FULL BLOCKS ONLY: a partially-filled block is never registered; its
+  contents still change as decode appends.  Registered blocks are
+  immutable — prefill writes stop before them (the scheduler starts the
+  suffix prefill at the first unmapped block boundary) and decode appends
+  strictly past the prompt.
+- COPY-ON-WRITE BY RECOMPUTE: a request that diverges inside (or
+  extends past) a shared block never writes the shared copy.  The
+  scheduler maps only fully-matching blocks, allocates a PRIVATE block
+  for the first divergent/partial position and recomputes it from the
+  block-aligned start — the "copy" is a fresh prefill of one block, so
+  no device-side memcpy path exists at all.
+- REFCOUNTS: a mapped block holds one reference per slot whose table
+  points at it.  ``free`` releases references; at zero a REGISTERED
+  block parks on a per-shard LRU of evictable blocks (still cached, not
+  free), an unregistered one returns to the free list.
+- EVICTION NEVER STEALS CAPACITY: ``allocate`` counts evictable blocks
+  as available and evicts them LRU-first (unregistering their keys)
+  when the free list runs short — a fully-referenced pool behaves
+  exactly like the uncached allocator, and cached-but-idle blocks are
+  reclaimed before any live request ever waits.
+- INVALIDATION: cached K/V is a function of the WEIGHTS that produced
+  it, so ``invalidate_prefix_cache`` (called by the scheduler on hot
+  weight reload) drops every key and returns evictable blocks to the
+  free list; in-flight requests keep their references and simply free
+  to the pool when they retire.
 """
 
 from __future__ import annotations
 
+import collections
+import hashlib
 from typing import Dict, List
+
+import numpy as np
 
 from distributed_tensorflow_tpu.obs import metrics as obs_metrics
 
@@ -51,8 +95,35 @@ def _block_instruments(registry=None):
         "allocs": r.counter(
             "dtt_kv_blocks_alloc_total", "Blocks handed out"),
         "frees": r.counter(
-            "dtt_kv_blocks_freed_total", "Blocks returned"),
+            "dtt_kv_blocks_freed_total", "Block references released"),
+        "evictable": r.gauge(
+            "dtt_kv_blocks_evictable",
+            "Zero-ref prefix-cached blocks reclaimable under pressure"),
+        "prefix_cached": r.gauge(
+            "dtt_kv_prefix_cached_blocks",
+            "Blocks registered in the prefix cache (any refcount)"),
+        "prefix_evictions": r.counter(
+            "dtt_kv_prefix_evictions_total",
+            "Prefix-cached blocks evicted LRU-first under pool pressure"),
     }
+
+
+def chain_block_keys(tokens, block_size: int) -> List[bytes]:
+    """Content keys for every FULL block of ``tokens``: block ``i``'s key
+    is ``sha256(key_{i-1} || tokens[i*bs:(i+1)*bs])``, so a key identifies
+    the block's contents AND its whole prefix.  The trailing partial block
+    (if any) gets no key — it is never shareable."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int64).reshape(-1))
+    keys: List[bytes] = []
+    prev = b""
+    for i in range(len(toks) // block_size):
+        h = hashlib.sha256(prev)
+        h.update(toks[i * block_size:(i + 1) * block_size].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
 
 
 class BlockExhaustedError(RuntimeError):
@@ -64,7 +135,9 @@ class BlockExhaustedError(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` physical KV blocks.
+    """Refcounted free-list allocator over ``num_blocks`` physical KV
+    blocks, with an optional content-addressed prefix cache (see the
+    module docstring for the sharing invariants).
 
     Each shard's first block is reserved (trash); ``capacity`` is
     therefore ``num_blocks - num_shards`` (``num_blocks - 1`` in the
@@ -99,6 +172,20 @@ class BlockAllocator:
             list(range((s + 1) * per - 1, s * per, -1))
             for s in range(self.num_shards)]
         self._owner: Dict[int, int] = {}  # block id -> slot id (debugging)
+        # Block id -> live references (slots whose table maps the block).
+        # Membership here is what "allocated" means; a freed-to-zero block
+        # leaves this map (to the free list, or — registered — to the
+        # evictable LRU below).
+        self._refs: Dict[int, int] = {}
+        # Prefix cache: per-shard chained-hash -> block id, the reverse
+        # map, and the per-shard LRU of zero-ref registered blocks
+        # (insertion order = eviction order; revives pop from it).
+        self._cached: List[Dict[bytes, int]] = [
+            {} for _ in range(self.num_shards)]
+        self._key_of: Dict[int, bytes] = {}
+        self._evictable_by_shard: List["collections.OrderedDict[int, None]"]\
+            = [collections.OrderedDict() for _ in range(self.num_shards)]
+        self.prefix_evictions = 0
         self.high_water = 0
         self._obs = _block_instruments()
         self._publish_gauges()
@@ -107,6 +194,8 @@ class BlockAllocator:
         self._obs["in_use"].set(self.used_count)
         self._obs["free"].set(self.free_count)
         self._obs["high_water"].set(self.high_water)
+        self._obs["evictable"].set(self.evictable_count)
+        self._obs["prefix_cached"].set(len(self._key_of))
 
     @property
     def capacity(self) -> int:
@@ -121,11 +210,26 @@ class BlockAllocator:
         return sum(len(f) for f in self._free_by_shard)
 
     @property
+    def evictable_count(self) -> int:
+        return sum(len(e) for e in self._evictable_by_shard)
+
+    @property
     def used_count(self) -> int:
-        return self.capacity - self.free_count
+        return self.capacity - self.free_count - self.evictable_count
+
+    @property
+    def cached_block_count(self) -> int:
+        return len(self._key_of)
 
     def free_count_shard(self, shard: int) -> int:
         return len(self._free_by_shard[shard])
+
+    def evictable_count_shard(self, shard: int) -> int:
+        return len(self._evictable_by_shard[shard])
+
+    def ref_count(self, block: int) -> int:
+        """Live references on ``block`` (0 = free or parked evictable)."""
+        return self._refs.get(block, 0)
 
     def trash_block(self, shard: int = 0) -> int:
         """The reserved never-allocated block absorbing inactive rows'
@@ -141,42 +245,143 @@ class BlockAllocator:
 
     def allocate(self, n: int, *, slot: int = -1,
                  shard: int = 0) -> List[int]:
-        """Pop ``n`` blocks off ``shard``'s free list; raises
-        ``BlockExhaustedError`` if fewer are free there — a full peer
-        shard cannot lend blocks (they live on other devices)."""
+        """Pop ``n`` blocks off ``shard``'s free list, evicting zero-ref
+        prefix-cached blocks LRU-first when the free list alone runs
+        short; raises ``BlockExhaustedError`` if free + evictable cannot
+        cover it — a full peer shard cannot lend blocks (they live on
+        other devices)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         free = self._free_by_shard[shard]
-        if n > len(free):
+        evictable = self._evictable_by_shard[shard]
+        if n > len(free) + len(evictable):
             where = f" in shard {shard}" if self.num_shards > 1 else ""
             raise BlockExhaustedError(
-                f"need {n} blocks, only {len(free)}/{self.capacity_per_shard}"
+                f"need {n} blocks, only "
+                f"{len(free) + len(evictable)}/{self.capacity_per_shard}"
                 f" free{where}")
+        while len(free) < n:
+            victim, _ = evictable.popitem(last=False)  # LRU end
+            self._unregister(victim)
+            free.append(victim)
+            self.prefix_evictions += 1
+            self._obs["prefix_evictions"].inc()
         blocks = [free.pop() for _ in range(n)]
         for b in blocks:
             self._owner[b] = slot
+            self._refs[b] = 1
         self.high_water = max(self.high_water, self.used_count)
         self._obs["allocs"].inc(n)
         self._publish_gauges()
         return blocks
 
     def free(self, blocks: List[int]) -> None:
-        """Return a slot's blocks to the pool (bulk-free on retire); each
-        block routes back to the shard its id belongs to."""
+        """Release one reference per block (bulk on retire).  A block
+        whose refcount drains to zero returns to its shard's pool:
+        registered blocks park on the evictable LRU (still cached),
+        unregistered ones rejoin the free list.  Releasing a block with
+        no live references — already free, parked, or never allocated —
+        raises instead of silently corrupting the LIFO list."""
         for b in blocks:
             if b % self.blocks_per_shard == 0:
                 raise ValueError(
                     f"block {b} (trash) is never allocated/freed")
-            shard_free = self._free_by_shard[self.shard_of(b)]
-            if b in self._owner:
-                del self._owner[b]
-            elif b in shard_free:
+            refs = self._refs.get(b, 0)
+            if refs <= 0:
                 raise ValueError(f"double free of block {b}")
-            shard_free.append(b)
-        if self.free_count > self.capacity:
+            if refs > 1:
+                self._refs[b] = refs - 1
+                continue
+            del self._refs[b]
+            self._owner.pop(b, None)
+            sh = self.shard_of(b)
+            if b in self._key_of:
+                self._evictable_by_shard[sh][b] = None  # MRU end
+            else:
+                self._free_by_shard[sh].append(b)
+        if self.free_count + self.evictable_count > self.capacity:
             raise AssertionError("freed more blocks than exist")
         self._obs["frees"].inc(len(blocks))
         self._publish_gauges()
+
+    # -- prefix cache ---------------------------------------------------------
+
+    def lookup_prefix(self, keys: List[bytes], shard: int = 0) -> int:
+        """Longest cached chain: how many leading ``keys`` are registered
+        in ``shard``'s map.  Read-only (no refcount change)."""
+        cached = self._cached[shard]
+        n = 0
+        for key in keys:
+            if key not in cached:
+                break
+            n += 1
+        return n
+
+    def acquire_prefix(self, keys: List[bytes],
+                       shard: int = 0) -> List[int]:
+        """Map the longest cached chain of ``keys``: walks the per-shard
+        map, bumps each hit block's refcount (reviving zero-ref blocks
+        off the evictable LRU), and returns the physical block ids in
+        chain order.  Stops at the first miss — the caller prefills from
+        ``len(result) * block_size``."""
+        cached = self._cached[shard]
+        out: List[int] = []
+        for key in keys:
+            b = cached.get(key)
+            if b is None:
+                break
+            if b in self._refs:
+                self._refs[b] += 1
+            else:
+                del self._evictable_by_shard[shard][b]
+                self._refs[b] = 1
+            out.append(b)
+        if out:
+            self.high_water = max(self.high_water, self.used_count)
+            self._publish_gauges()
+        return out
+
+    def register_prefix(self, blocks: List[int], keys: List[bytes],
+                        shard: int = 0) -> int:
+        """Publish ``blocks[i]`` (a live, fully-written prompt block)
+        under ``keys[i]``.  A key another block already holds, or a block
+        already registered, is skipped — registration is idempotent and
+        first-writer-wins.  Returns how many NEW entries were added."""
+        cached = self._cached[shard]
+        added = 0
+        for b, key in zip(blocks, keys):
+            if key in cached or b in self._key_of:
+                continue
+            if self._refs.get(b, 0) <= 0:
+                raise ValueError(
+                    f"cannot register unallocated block {b}")
+            self._key_of[b] = key
+            cached[key] = b
+            added += 1
+        if added:
+            self._publish_gauges()
+        return added
+
+    def invalidate_prefix_cache(self) -> int:
+        """Drop every cached key (hot weight reload: cached K/V is a
+        function of the weights).  Evictable blocks return to their free
+        lists; live shared blocks keep their refcounts and free normally
+        at retirement.  Returns the number of entries dropped."""
+        dropped = len(self._key_of)
+        for shard in range(self.num_shards):
+            free = self._free_by_shard[shard]
+            evictable = self._evictable_by_shard[shard]
+            free.extend(evictable)
+            evictable.clear()
+            self._cached[shard].clear()
+        self._key_of.clear()
+        self._publish_gauges()
+        return dropped
+
+    def _unregister(self, block: int) -> None:
+        key = self._key_of.pop(block, None)
+        if key is not None:
+            self._cached[self.shard_of(block)].pop(key, None)
 
     def stats(self) -> Dict[str, float]:
         out = {
@@ -186,6 +391,9 @@ class BlockAllocator:
             "block_utilization": (self.used_count / self.capacity
                                   if self.capacity else 0.0),
             "blocks_high_water": float(self.high_water),
+            "blocks_evictable": float(self.evictable_count),
+            "prefix_cached_blocks": float(len(self._key_of)),
+            "prefix_evictions": float(self.prefix_evictions),
         }
         if self.num_shards > 1:
             out["num_shards"] = float(self.num_shards)

@@ -14,6 +14,9 @@ Examples:
         --prompt_lens=8,16,24 --min_new_tokens=4             # continuous batching
     python serve.py --model=gpt2 --continuous --cache_mode=paged \
         --block_size=16 --kv_dtype=int8                      # paged + int8 KV
+    python serve.py --model=gpt2 --continuous --cache_mode=paged \
+        --prefix_cache --shared_prefix_len=256 \
+        --shared_prefix_groups=4      # prefix caching over shared prompts
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
@@ -100,6 +103,20 @@ def parse_args(argv=None):
                         "mesh's data shards — each shard owns "
                         "num_blocks/data blocks and slot tables index "
                         "only their own shard's range")
+    p.add_argument("--prefix_cache", action="store_true",
+                   default=defaults.prefix_cache,
+                   help="paged mode: content-addressed prefix caching — "
+                        "requests sharing full leading prompt blocks map "
+                        "them from cache (refcounted, copy-on-write) and "
+                        "prefill only the uncached suffix")
+    p.add_argument("--shared_prefix_len", type=int,
+                   default=defaults.shared_prefix_len,
+                   help="traffic mix: prepend a shared system prompt of "
+                        "this many tokens to every request (0 = off)")
+    p.add_argument("--shared_prefix_groups", type=int,
+                   default=defaults.shared_prefix_groups,
+                   help="distinct shared prefixes the traffic cycles "
+                        "through (with --shared_prefix_len)")
     p.add_argument("--num_replicas", type=int, default=defaults.num_replicas,
                    help=">1 serves a fleet: N replica engines behind a "
                         "load-aware router (requires --continuous)")

@@ -183,6 +183,21 @@ class TestRouterDispatch:
                 router.submit("x")
             assert router.stats()["shed"] == 1.0
 
+    def test_submit_with_tracer_enabled_records_route_span(self):
+        # regression: submit() crashed with the flight recorder on (the
+        # fleet_route span was recorded without its start/end times)
+        from distributed_tensorflow_tpu.obs.trace import default_tracer
+        tracer = default_tracer()
+        was_enabled = tracer.enabled
+        tracer.enable()
+        try:
+            with self._router([_StubReplica(0)]) as router:
+                assert router.submit("x").replica == 0
+            assert any(e["name"] == "fleet_route" for e in tracer.events())
+        finally:
+            if not was_enabled:
+                tracer.disable()
+
     def test_closed_router_rejects(self):
         router = self._router([_StubReplica(0)])
         router.close()

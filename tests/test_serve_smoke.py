@@ -88,6 +88,24 @@ def test_serve_entrypoint_paged_int8_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_prefix_cache_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--cache_mode=paged", "--block_size=4",
+                "--prefix_cache", "--shared_prefix_len=16",
+                "--shared_prefix_groups=2", "--num_slots=8", "--steps=16",
+                "--prompt_lens=6,8", "--max_new_tokens=6",
+                "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    assert out["prefix_cache"] is True
+    assert out["completed"] == 16
+    assert out["prefix_hit_rate"] > 0
+    assert out["prefill_tokens_skipped"] > 0
+    assert out["prefix_cached_blocks"] >= 0
+    assert len(out["tokens_checksum"]) == 16
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
@@ -114,3 +132,11 @@ def test_bench_serve_mode_prints_one_json_line():
     assert out["kv_hbm_bytes"]["paged"] < out["kv_hbm_bytes"]["dense"]
     assert out["kv_hbm_ratio_paged"] <= 0.5
     assert out["kv_hbm_ratio_paged_int8"] <= 0.25
+    # the prefix-caching claim: shared-prefix traffic hits the cache and
+    # the warm run's greedy tokens are bit-identical to the cold run's
+    for key in ("prefix_hit_rate", "prefill_tokens_skipped",
+                "ttft_speedup_prefix", "prefix_parity"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["prefix_hit_rate"] > 0
+    assert out["prefill_tokens_skipped"] > 0
+    assert out["prefix_parity"] is True

@@ -27,39 +27,26 @@ owns a lock (an attribute assigned ``threading.Lock()`` / ``RLock()`` /
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Sequence, Set, Tuple
 
 from distributed_tensorflow_tpu.analysis.core import (
+    LOCK_FACTORIES,
+    MUTATOR_METHODS,
     Finding,
     Module,
     Rule,
-    dotted,
+    infer_lock_attrs,
+    self_attr,
 )
 
 RULE_ID = "lock-discipline"
 
-_LOCK_FACTORIES = {
-    "threading.Lock", "threading.RLock", "threading.Condition",
-    "Lock", "RLock", "Condition",
-}
-
-# Mutating container methods whose call counts as a write to the
-# receiver attribute.  queue.Queue's put/get/task_done and Event's
-# set/clear-alikes are internally synchronized — excluded on purpose
-# (Event.set IS `set` but Events are never inferred guarded because
-# they are never written under a lock as attributes).
-_MUTATOR_METHODS = {
-    "append", "appendleft", "extend", "insert", "pop", "popleft",
-    "remove", "clear", "add", "discard", "update", "setdefault", "sort",
-}
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """'x' for a bare ``self.x`` attribute node."""
-    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
-            and node.value.id == "self":
-        return node.attr
-    return None
+# Backwards-compatible aliases: the lock factory set, the union-find and
+# the mutator-method set moved to core so the whole-program concurrency
+# fact layer (ConcurrencyFacts) shares ONE inference with this rule.
+_LOCK_FACTORIES = LOCK_FACTORIES
+_MUTATOR_METHODS = MUTATOR_METHODS
+_self_attr = self_attr
 
 
 class _ClassModel:
@@ -81,36 +68,9 @@ class _ClassModel:
 
     def _find_locks(self) -> None:
         """Lock attrs from ``self._x = threading.Lock()`` etc., with
-        ``Condition(self._lock)`` aliased into the wrapped lock's group."""
-        group_of: Dict[str, int] = {}
-        next_group = 0
-        for method in self.methods.values():
-            for node in ast.walk(method):
-                if not (isinstance(node, ast.Assign)
-                        and isinstance(node.value, ast.Call)):
-                    continue
-                callee = dotted(node.value.func)
-                if callee is None or callee not in _LOCK_FACTORIES:
-                    continue
-                for t in node.targets:
-                    attr = _self_attr(t)
-                    if attr is None:
-                        continue
-                    # Condition(self._lock): join the wrapped lock's group.
-                    wrapped = None
-                    if node.value.args:
-                        wrapped = _self_attr(node.value.args[0])
-                    if wrapped is not None and wrapped in group_of:
-                        group_of[attr] = group_of[wrapped]
-                    else:
-                        if wrapped is not None:
-                            group_of[wrapped] = next_group
-                            group_of[attr] = next_group
-                            next_group += 1
-                        else:
-                            group_of[attr] = next_group
-                            next_group += 1
-        self.lock_group = group_of
+        ``Condition(self._lock)`` aliased into the wrapped lock's group
+        (shared union-find — see ``core.infer_lock_attrs``)."""
+        self.lock_group = infer_lock_attrs(self.methods.values())
 
     @property
     def has_locks(self) -> bool:

@@ -4,6 +4,11 @@ from __future__ import annotations
 
 from typing import List
 
+from distributed_tensorflow_tpu.analysis.concurrency import (
+    CollectiveLaunchRule,
+    CrossThreadRaceRule,
+    LockOrderRule,
+)
 from distributed_tensorflow_tpu.analysis.core import Rule
 from distributed_tensorflow_tpu.analysis.hygiene import (
     MutableDefaultRule,
@@ -20,6 +25,9 @@ def default_rules() -> List[Rule]:
         JitPurityRule(),
         RecompileHazardRule(),
         LockDisciplineRule(),
+        LockOrderRule(),
+        CrossThreadRaceRule(),
+        CollectiveLaunchRule(),
         LayeringRule(),
         UnusedImportRule(),
         MutableDefaultRule(),

@@ -142,6 +142,11 @@ class HealthChecker:
         self._on_failure = on_failure
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Guards the probe-state fields shared between the checker thread
+        # and the training loop: _consecutive_failures, _ready,
+        # _started_at, error.  The probe itself (a timed barrier) always
+        # runs OUTSIDE the lock.
+        self._lock = threading.Lock()
         self._consecutive_failures = 0
         self._ready = False
         self._started_at: Optional[float] = None
@@ -150,7 +155,8 @@ class HealthChecker:
     def start(self) -> "HealthChecker":
         if self._thread is not None:
             return self
-        self._started_at = time.time()
+        with self._lock:
+            self._started_at = time.time()
         self._thread = threading.Thread(
             target=self._run, name="dtt-health-check", daemon=True
         )
@@ -162,9 +168,10 @@ class HealthChecker:
         probes now count against ``failures_before_action`` directly
         instead of the startup grace window.  Failures accumulated while
         the grace tolerated them don't carry over."""
-        if not self._ready:
-            self._consecutive_failures = 0
-        self._ready = True
+        with self._lock:
+            if not self._ready:
+                self._consecutive_failures = 0
+            self._ready = True
 
     def stop(self) -> None:
         self._stop.set()
@@ -187,39 +194,48 @@ class HealthChecker:
             except Exception as e:
                 logger.error("health probe raised: %s", e)
             if healthy:
-                self._consecutive_failures = 0
-                self._ready = True  # one full barrier proves every peer is up
-                continue
-            self._consecutive_failures += 1
-            if not self._ready:
-                # Startup: peers may legitimately miss probe barriers while
-                # they compile (skewed startup), so failures are fatal only
-                # once the grace window is exhausted — a peer that NEVER
-                # comes up still surfaces instead of hanging this worker in
-                # the first collective forever.  Tolerated failures reset
-                # the counter so they never carry past the grace window.
-                elapsed = time.time() - (self._started_at or 0.0)
-                if elapsed < self.startup_grace_s:
+                with self._lock:
                     self._consecutive_failures = 0
-                    logger.warning(
-                        "health probe failed during startup grace "
-                        "(%.0fs/%.0fs elapsed); tolerating",
-                        elapsed, self.startup_grace_s,
-                    )
-                    continue
-            if self._consecutive_failures >= self.failures_before_action:
-                self.error = RuntimeError(
-                    f"cluster unhealthy: {self._consecutive_failures} "
+                    # one full barrier proves every peer is up
+                    self._ready = True
+                continue
+            with self._lock:
+                self._consecutive_failures += 1
+                if not self._ready:
+                    # Startup: peers may legitimately miss probe barriers
+                    # while they compile (skewed startup), so failures are
+                    # fatal only once the grace window is exhausted — a
+                    # peer that NEVER comes up still surfaces instead of
+                    # hanging this worker in the first collective forever.
+                    # Tolerated failures reset the counter so they never
+                    # carry past the grace window.
+                    elapsed = time.time() - (self._started_at or 0.0)
+                    if elapsed < self.startup_grace_s:
+                        self._consecutive_failures = 0
+                        logger.warning(
+                            "health probe failed during startup grace "
+                            "(%.0fs/%.0fs elapsed); tolerating",
+                            elapsed, self.startup_grace_s,
+                        )
+                        continue
+                failures = self._consecutive_failures
+            if failures >= self.failures_before_action:
+                err = RuntimeError(
+                    f"cluster unhealthy: {failures} "
                     "consecutive failed health probes"
                 )
-                logger.error("%s", self.error)
+                with self._lock:
+                    self.error = err
+                logger.error("%s", err)
                 if self._on_failure is not None:
                     self._on_failure()
                 return
 
     def raise_if_unhealthy(self) -> None:
-        if self.error is not None:
-            raise self.error
+        with self._lock:
+            err = self.error
+        if err is not None:
+            raise err
 
 
 class HealthCheckHook:

@@ -15,6 +15,7 @@ for anyone embedding evaluation-style serving inside a training run.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, Optional
 
 from distributed_tensorflow_tpu.obs.metrics import Registry, default_registry
@@ -42,6 +43,9 @@ class ServeMonitorHook(Hook):
         self._source = source
         self._registry = registry or default_registry()
         self.every_steps = max(1, every_steps)
+        # last_stats is read by dashboards/tests while serve worker
+        # threads drive log(); publish snapshots under a lock.
+        self._lock = threading.Lock()
         self.last_stats: Dict[str, float] = {}
 
     def _snapshot(self) -> Optional[Dict[str, float]]:
@@ -55,8 +59,9 @@ class ServeMonitorHook(Hook):
             s = fn() if callable(fn) else None
         if s is None:
             return None
-        self.last_stats = s
-        return self.last_stats
+        with self._lock:
+            self.last_stats = s
+        return s
 
     def metrics(self) -> Dict[str, float]:
         """Current counters under the ``serve_`` metric namespace."""

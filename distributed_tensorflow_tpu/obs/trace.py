@@ -42,7 +42,19 @@ class Tracer:
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._epoch = time.monotonic()
-        self.enabled = enabled
+        self._enabled = enabled
+
+    @property
+    def enabled(self) -> bool:
+        """Toggled from the main thread while worker threads record —
+        reads and writes share the ring buffer's lock."""
+        with self._lock:
+            return self._enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        with self._lock:
+            self._enabled = bool(value)
 
     def enable(self) -> None:
         self.enabled = True

@@ -220,20 +220,21 @@ class ServeEngine:
         """Jitted fixed-batch decode step for one sampling config.  The
         greedy program is EXACTLY the pre-sampling one (no rng/counter
         arguments), so the default path stays bit-identical."""
-        if temperature <= 0.0:
-            if "step" not in self._generate_fns:
+        with _launch_lock:
+            if temperature <= 0.0:
+                if "step" not in self._generate_fns:
+                    self._obs["compiles"].labels(kind="decode_step").inc()
+                    self._generate_fns["step"] = jax.jit(
+                        self._decode_apply, donate_argnums=(1,))
+                return self._generate_fns["step"]
+            key = ("step", float(temperature), int(top_k))
+            if key not in self._generate_fns:
                 self._obs["compiles"].labels(kind="decode_step").inc()
-                self._generate_fns["step"] = jax.jit(
-                    self._decode_apply, donate_argnums=(1,))
-            return self._generate_fns["step"]
-        key = ("step", float(temperature), int(top_k))
-        if key not in self._generate_fns:
-            self._obs["compiles"].labels(kind="decode_step").inc()
-            self._generate_fns[key] = jax.jit(
-                functools.partial(self._sampled_decode_apply,
-                                  float(temperature), int(top_k)),
-                donate_argnums=(1,))
-        return self._generate_fns[key]
+                self._generate_fns[key] = jax.jit(
+                    functools.partial(self._sampled_decode_apply,
+                                      float(temperature), int(top_k)),
+                    donate_argnums=(1,))
+            return self._generate_fns[key]
 
     def init_cache(self, batch: int, total_len: int) -> PyTree:
         """Preallocated, sharded KV cache for ``batch`` rows of up to
@@ -474,17 +475,17 @@ class ServeEngine:
                 "start_offsets > 0 requires the paged cache (prefix "
                 "blocks are mapped through the block table)")
         key = ("slot_prefill", float(temperature), int(top_k), paged)
-        if key not in self._generate_fns:
-            self._obs["compiles"].labels(kind="slot_prefill").inc()
-            self._generate_fns[key] = jax.jit(
-                functools.partial(self._prefill_slots_apply,
-                                  float(temperature), int(top_k), paged),
-                donate_argnums=(1,))
         base = rng if rng is not None else self._sample_rng
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
         t0 = time.perf_counter()
         with _launch_lock:
+            if key not in self._generate_fns:
+                self._obs["compiles"].labels(kind="slot_prefill").inc()
+                self._generate_fns[key] = jax.jit(
+                    functools.partial(self._prefill_slots_apply,
+                                      float(temperature), int(top_k), paged),
+                    donate_argnums=(1,))
             out = self._generate_fns[key](
                 self.params if params is None else params, cache, prompts,
                 np.asarray(slot_ids, np.int32), bt, base, counter, starts)
@@ -538,17 +539,17 @@ class ServeEngine:
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         key = ("slot_decode", float(temperature), int(top_k), paged)
-        if key not in self._generate_fns:
-            self._obs["compiles"].labels(kind="slot_decode").inc()
-            self._generate_fns[key] = jax.jit(
-                functools.partial(self._decode_slots_apply,
-                                  float(temperature), int(top_k), paged),
-                donate_argnums=(1,))
         base = rng if rng is not None else self._sample_rng
         bt = None if block_tables is None else np.asarray(
             block_tables, np.int32)
         t0 = time.perf_counter()
         with _launch_lock:
+            if key not in self._generate_fns:
+                self._obs["compiles"].labels(kind="slot_decode").inc()
+                self._generate_fns[key] = jax.jit(
+                    functools.partial(self._decode_slots_apply,
+                                      float(temperature), int(top_k), paged),
+                    donate_argnums=(1,))
             tokens_dev = jax.device_put(
                 np.asarray(last_tokens, np.int32),
                 batch_sharding(self.mesh))
@@ -597,10 +598,11 @@ class ServeEngine:
         base = rng if rng is not None else self._sample_rng
         cache = self.init_cache(B, total)
         tokens_dev = jax.device_put(prompts, batch_sharding(self.mesh))
-        if greedy:
-            tok, cache = step(self.params, cache, tokens_dev)
-        else:
-            tok, cache = step(self.params, cache, tokens_dev, base, 0)
+        with _launch_lock:
+            if greedy:
+                tok, cache = step(self.params, cache, tokens_dev)
+            else:
+                tok, cache = step(self.params, cache, tokens_dev, base, 0)
         out = [tok]
         done = (tok == eos_token) if eos_token is not None else None
         check_every = max(1, eos_check_every)
@@ -608,10 +610,12 @@ class ServeEngine:
             if (done is not None and i % check_every == 0
                     and bool(jax.device_get(done).all())):
                 break
-            if greedy:
-                tok, cache = step(self.params, cache, tok[:, None])
-            else:
-                tok, cache = step(self.params, cache, tok[:, None], base, i)
+            with _launch_lock:
+                if greedy:
+                    tok, cache = step(self.params, cache, tok[:, None])
+                else:
+                    tok, cache = step(
+                        self.params, cache, tok[:, None], base, i)
             out.append(tok)
             if done is not None:
                 done = done | (tok == eos_token)
@@ -659,8 +663,10 @@ class ServeEngine:
         sh = batch_sharding(self.mesh)
         dev_batch = {k: jax.device_put(np.asarray(v), sh)
                      for k, v in batch.items()}
-        return np.asarray(jax.device_get(
-            self._predict_fn(self.params, self.model_state, dev_batch)))
+        with _launch_lock:
+            logits = self._predict_fn(self.params, self.model_state,
+                                      dev_batch)
+        return np.asarray(jax.device_get(logits))
 
     def classify_batch(self, examples: List[Dict[str, np.ndarray]]
                        ) -> List[int]:
@@ -685,6 +691,14 @@ class ServeEngine:
             self.mesh, {"params": params})
         with _launch_lock:
             return apply_shardings({"params": params}, shardings)["params"]
+
+    def install_params(self, params: PyTree) -> None:
+        """Swap the live weights (hot reload).  The assignment runs under
+        the launch lock, so every launch path that reads ``self.params``
+        inside the lock sees either the old or the new tree — never a
+        swap interleaved with a dispatch."""
+        with _launch_lock:
+            self.params = params
 
     # -- lifecycle -----------------------------------------------------------
 

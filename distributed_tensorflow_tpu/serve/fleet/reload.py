@@ -111,7 +111,10 @@ class CheckpointWatcher:
             # Move the engine's own reference forward too: the fixed-batch
             # paths serve the new weights, and nothing keeps the old
             # generation's buffers alive once its last request retires.
-            rep.engine.params = device_params
+            # install_params swaps under the engine's launch lock so a
+            # concurrently dispatching path never reads a half-installed
+            # reference.
+            rep.engine.install_params(device_params)
         with self._lock:
             self._last_step = step
             self._reloads += 1

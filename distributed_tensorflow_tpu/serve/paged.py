@@ -74,6 +74,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import threading
 from typing import Dict, List
 
 import numpy as np
@@ -141,9 +142,10 @@ class BlockAllocator:
 
     Each shard's first block is reserved (trash); ``capacity`` is
     therefore ``num_blocks - num_shards`` (``num_blocks - 1`` in the
-    default single-shard layout, where block 0 is the trash block).  Not
-    thread-safe by itself — the scheduler calls it only from its loop
-    thread (or under its lock for stats).
+    default single-shard layout, where block 0 is the trash block).
+    Thread-safe: every mutating method and every stats reader takes the
+    allocator's own re-entrant lock, so the scheduler loop and
+    main-thread stats/metrics readers can't observe torn bookkeeping.
     """
 
     def __init__(self, num_blocks: int, block_size: int, *,
@@ -161,6 +163,9 @@ class BlockAllocator:
                 f"{num_shards} shards")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        # Re-entrant so locked methods may call the stats properties (or
+        # each other) without a wrapper-vs-raw split.
+        self._lock = threading.RLock()
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_shards = int(num_shards)
@@ -219,7 +224,8 @@ class BlockAllocator:
 
     @property
     def cached_block_count(self) -> int:
-        return len(self._key_of)
+        with self._lock:
+            return len(self._key_of)
 
     def free_count_shard(self, shard: int) -> int:
         return len(self._free_by_shard[shard])
@@ -229,7 +235,8 @@ class BlockAllocator:
 
     def ref_count(self, block: int) -> int:
         """Live references on ``block`` (0 = free or parked evictable)."""
-        return self._refs.get(block, 0)
+        with self._lock:
+            return self._refs.get(block, 0)
 
     def trash_block(self, shard: int = 0) -> int:
         """The reserved never-allocated block absorbing inactive rows'
@@ -252,28 +259,29 @@ class BlockAllocator:
         other devices)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        free = self._free_by_shard[shard]
-        evictable = self._evictable_by_shard[shard]
-        if n > len(free) + len(evictable):
-            where = f" in shard {shard}" if self.num_shards > 1 else ""
-            raise BlockExhaustedError(
-                f"need {n} blocks, only "
-                f"{len(free) + len(evictable)}/{self.capacity_per_shard}"
-                f" free{where}")
-        while len(free) < n:
-            victim, _ = evictable.popitem(last=False)  # LRU end
-            self._unregister(victim)
-            free.append(victim)
-            self.prefix_evictions += 1
-            self._obs["prefix_evictions"].inc()
-        blocks = [free.pop() for _ in range(n)]
-        for b in blocks:
-            self._owner[b] = slot
-            self._refs[b] = 1
-        self.high_water = max(self.high_water, self.used_count)
-        self._obs["allocs"].inc(n)
-        self._publish_gauges()
-        return blocks
+        with self._lock:
+            free = self._free_by_shard[shard]
+            evictable = self._evictable_by_shard[shard]
+            if n > len(free) + len(evictable):
+                where = f" in shard {shard}" if self.num_shards > 1 else ""
+                raise BlockExhaustedError(
+                    f"need {n} blocks, only "
+                    f"{len(free) + len(evictable)}"
+                    f"/{self.capacity_per_shard} free{where}")
+            while len(free) < n:
+                victim, _ = evictable.popitem(last=False)  # LRU end
+                self._unregister(victim)
+                free.append(victim)
+                self.prefix_evictions += 1
+                self._obs["prefix_evictions"].inc()
+            blocks = [free.pop() for _ in range(n)]
+            for b in blocks:
+                self._owner[b] = slot
+                self._refs[b] = 1
+            self.high_water = max(self.high_water, self.used_count)
+            self._obs["allocs"].inc(n)
+            self._publish_gauges()
+            return blocks
 
     def free(self, blocks: List[int]) -> None:
         """Release one reference per block (bulk on retire).  A block
@@ -282,40 +290,42 @@ class BlockAllocator:
         unregistered ones rejoin the free list.  Releasing a block with
         no live references — already free, parked, or never allocated —
         raises instead of silently corrupting the LIFO list."""
-        for b in blocks:
-            if b % self.blocks_per_shard == 0:
-                raise ValueError(
-                    f"block {b} (trash) is never allocated/freed")
-            refs = self._refs.get(b, 0)
-            if refs <= 0:
-                raise ValueError(f"double free of block {b}")
-            if refs > 1:
-                self._refs[b] = refs - 1
-                continue
-            del self._refs[b]
-            self._owner.pop(b, None)
-            sh = self.shard_of(b)
-            if b in self._key_of:
-                self._evictable_by_shard[sh][b] = None  # MRU end
-            else:
-                self._free_by_shard[sh].append(b)
-        if self.free_count + self.evictable_count > self.capacity:
-            raise AssertionError("freed more blocks than exist")
-        self._obs["frees"].inc(len(blocks))
-        self._publish_gauges()
+        with self._lock:
+            for b in blocks:
+                if b % self.blocks_per_shard == 0:
+                    raise ValueError(
+                        f"block {b} (trash) is never allocated/freed")
+                refs = self._refs.get(b, 0)
+                if refs <= 0:
+                    raise ValueError(f"double free of block {b}")
+                if refs > 1:
+                    self._refs[b] = refs - 1
+                    continue
+                del self._refs[b]
+                self._owner.pop(b, None)
+                sh = self.shard_of(b)
+                if b in self._key_of:
+                    self._evictable_by_shard[sh][b] = None  # MRU end
+                else:
+                    self._free_by_shard[sh].append(b)
+            if self.free_count + self.evictable_count > self.capacity:
+                raise AssertionError("freed more blocks than exist")
+            self._obs["frees"].inc(len(blocks))
+            self._publish_gauges()
 
     # -- prefix cache ---------------------------------------------------------
 
     def lookup_prefix(self, keys: List[bytes], shard: int = 0) -> int:
         """Longest cached chain: how many leading ``keys`` are registered
         in ``shard``'s map.  Read-only (no refcount change)."""
-        cached = self._cached[shard]
-        n = 0
-        for key in keys:
-            if key not in cached:
-                break
-            n += 1
-        return n
+        with self._lock:
+            cached = self._cached[shard]
+            n = 0
+            for key in keys:
+                if key not in cached:
+                    break
+                n += 1
+            return n
 
     def acquire_prefix(self, keys: List[bytes],
                        shard: int = 0) -> List[int]:
@@ -324,22 +334,23 @@ class BlockAllocator:
         off the evictable LRU), and returns the physical block ids in
         chain order.  Stops at the first miss — the caller prefills from
         ``len(result) * block_size``."""
-        cached = self._cached[shard]
-        out: List[int] = []
-        for key in keys:
-            b = cached.get(key)
-            if b is None:
-                break
-            if b in self._refs:
-                self._refs[b] += 1
-            else:
-                del self._evictable_by_shard[shard][b]
-                self._refs[b] = 1
-            out.append(b)
-        if out:
-            self.high_water = max(self.high_water, self.used_count)
-            self._publish_gauges()
-        return out
+        with self._lock:
+            cached = self._cached[shard]
+            out: List[int] = []
+            for key in keys:
+                b = cached.get(key)
+                if b is None:
+                    break
+                if b in self._refs:
+                    self._refs[b] += 1
+                else:
+                    del self._evictable_by_shard[shard][b]
+                    self._refs[b] = 1
+                out.append(b)
+            if out:
+                self.high_water = max(self.high_water, self.used_count)
+                self._publish_gauges()
+            return out
 
     def register_prefix(self, blocks: List[int], keys: List[bytes],
                         shard: int = 0) -> int:
@@ -347,36 +358,38 @@ class BlockAllocator:
         under ``keys[i]``.  A key another block already holds, or a block
         already registered, is skipped — registration is idempotent and
         first-writer-wins.  Returns how many NEW entries were added."""
-        cached = self._cached[shard]
-        added = 0
-        for b, key in zip(blocks, keys):
-            if key in cached or b in self._key_of:
-                continue
-            if self._refs.get(b, 0) <= 0:
-                raise ValueError(
-                    f"cannot register unallocated block {b}")
-            self._key_of[b] = key
-            cached[key] = b
-            added += 1
-        if added:
-            self._publish_gauges()
-        return added
+        with self._lock:
+            cached = self._cached[shard]
+            added = 0
+            for b, key in zip(blocks, keys):
+                if key in cached or b in self._key_of:
+                    continue
+                if self._refs.get(b, 0) <= 0:
+                    raise ValueError(
+                        f"cannot register unallocated block {b}")
+                self._key_of[b] = key
+                cached[key] = b
+                added += 1
+            if added:
+                self._publish_gauges()
+            return added
 
     def invalidate_prefix_cache(self) -> int:
         """Drop every cached key (hot weight reload: cached K/V is a
         function of the weights).  Evictable blocks return to their free
         lists; live shared blocks keep their refcounts and free normally
         at retirement.  Returns the number of entries dropped."""
-        dropped = len(self._key_of)
-        for shard in range(self.num_shards):
-            free = self._free_by_shard[shard]
-            evictable = self._evictable_by_shard[shard]
-            free.extend(evictable)
-            evictable.clear()
-            self._cached[shard].clear()
-        self._key_of.clear()
-        self._publish_gauges()
-        return dropped
+        with self._lock:
+            dropped = len(self._key_of)
+            for shard in range(self.num_shards):
+                free = self._free_by_shard[shard]
+                evictable = self._evictable_by_shard[shard]
+                free.extend(evictable)
+                evictable.clear()
+                self._cached[shard].clear()
+            self._key_of.clear()
+            self._publish_gauges()
+            return dropped
 
     def _unregister(self, block: int) -> None:
         key = self._key_of.pop(block, None)
@@ -384,19 +397,20 @@ class BlockAllocator:
             self._cached[self.shard_of(block)].pop(key, None)
 
     def stats(self) -> Dict[str, float]:
-        out = {
-            "blocks_total": float(self.capacity),
-            "blocks_free": float(self.free_count),
-            "blocks_in_use": float(self.used_count),
-            "block_utilization": (self.used_count / self.capacity
-                                  if self.capacity else 0.0),
-            "blocks_high_water": float(self.high_water),
-            "blocks_evictable": float(self.evictable_count),
-            "prefix_cached_blocks": float(len(self._key_of)),
-            "prefix_evictions": float(self.prefix_evictions),
-        }
-        if self.num_shards > 1:
-            out["num_shards"] = float(self.num_shards)
-            out["blocks_free_min_shard"] = float(
-                min(len(f) for f in self._free_by_shard))
-        return out
+        with self._lock:
+            out = {
+                "blocks_total": float(self.capacity),
+                "blocks_free": float(self.free_count),
+                "blocks_in_use": float(self.used_count),
+                "block_utilization": (self.used_count / self.capacity
+                                      if self.capacity else 0.0),
+                "blocks_high_water": float(self.high_water),
+                "blocks_evictable": float(self.evictable_count),
+                "prefix_cached_blocks": float(len(self._key_of)),
+                "prefix_evictions": float(self.prefix_evictions),
+            }
+            if self.num_shards > 1:
+                out["num_shards"] = float(self.num_shards)
+                out["blocks_free_min_shard"] = float(
+                    min(len(f) for f in self._free_by_shard))
+            return out

@@ -2,7 +2,8 @@
 # Static analysis gate: dttlint (always) + ruff (when installed).
 # Non-zero exit on any non-baselined finding from either tool.
 #
-#   scripts/lint.sh            # lint the whole tree
+#   scripts/lint.sh            # lint the whole tree; SARIF to /tmp/dttlint.sarif
+#   scripts/lint.sh --changed  # lint only files changed vs HEAD (fast pre-commit)
 #   scripts/lint.sh --json     # dttlint JSON output (ruff still text)
 set -u -o pipefail
 
@@ -12,7 +13,18 @@ cd "$repo_root"
 rc=0
 
 echo "== dttlint =="
-python -m distributed_tensorflow_tpu.analysis "$@" || rc=1
+if [ "${1:-}" = "--changed" ]; then
+    shift
+    # Changed-only slice: whole-program rules see just these files, so this
+    # is advisory speed, not the gate — the gate is the full run below.
+    git diff --name-only HEAD \
+        | python -m distributed_tensorflow_tpu.analysis --changed-only "$@" \
+        || rc=1
+else
+    # Full runs also emit SARIF for CI annotators / editor ingestion.
+    python -m distributed_tensorflow_tpu.analysis \
+        --sarif-out /tmp/dttlint.sarif "$@" || rc=1
+fi
 
 echo "== ruff =="
 if command -v ruff >/dev/null 2>&1; then

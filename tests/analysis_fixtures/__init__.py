@@ -1,0 +1,9 @@
+"""Seeded-defect fixtures for the dttlint concurrency rules.
+
+Each ``*_bad`` module plants exactly the defect its twin rule must
+catch; each ``*_clean`` module is the same shape with the defect fixed
+and must produce ZERO findings.  These modules are analyzed as source
+by ``tests/test_analysis_concurrency.py`` — they are never imported at
+runtime, and they are deliberately outside the analyzer's default
+target set so the tree-wide gate stays clean.
+"""

@@ -1,0 +1,16 @@
+"""Seeded blocking-call-under-lock: ``Future.result()`` awaited while
+holding the collector's lock — every other holder stalls behind an
+unbounded wait.  The ``lock-order`` warning tier must flag it."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = []
+
+    def drain(self, fut) -> None:
+        with self._lock:
+            value = fut.result()  # SEED: unbounded wait under self._lock
+            self._out.append(value)

@@ -1,0 +1,15 @@
+"""Clean twin of ``blocking_bad``: the future is resolved OUTSIDE the
+lock; only the cheap append runs under it."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = []
+
+    def drain(self, fut) -> None:
+        value = fut.result()
+        with self._lock:
+            self._out.append(value)

@@ -1,0 +1,28 @@
+"""Seeded unlocked collective launch: a jitted program dispatched from
+a worker thread with no module-level launch lock — two such threads
+deadlock in the XLA collective rendezvous (the PR 7 bug).
+``collective-launch`` must flag the dispatch site."""
+
+import threading
+
+import jax
+
+
+class MiniEngine:
+    def __init__(self):
+        self._step_fn = jax.jit(lambda x: x)
+
+    def run_step(self, batch):
+        return self._step_fn(batch)  # SEED: launch without a launch lock
+
+
+class Loop:
+    def __init__(self, engine: "MiniEngine"):
+        self.engine: "MiniEngine" = engine
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        self.engine.run_step(None)

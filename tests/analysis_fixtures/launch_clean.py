@@ -1,0 +1,30 @@
+"""Clean twin of ``launch_bad``: the dispatch holds a module-level
+launch lock (the ``serve.engine._launch_lock`` pattern), serializing
+collective launches across threads."""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class MiniEngine:
+    def __init__(self):
+        self._step_fn = jax.jit(lambda x: x)
+
+    def run_step(self, batch):
+        with _launch_lock:
+            return self._step_fn(batch)
+
+
+class Loop:
+    def __init__(self, engine: "MiniEngine"):
+        self.engine: "MiniEngine" = engine
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        self.engine.run_step(None)

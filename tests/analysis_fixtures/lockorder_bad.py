@@ -1,0 +1,31 @@
+"""Seeded lock-order inversion: Alpha acquires Beta's lock while
+holding its own, Beta acquires Alpha's the same way — a classic
+two-lock deadlock the ``lock-order`` rule must report as a cycle."""
+
+import threading
+
+
+class Alpha:
+    def __init__(self, beta: "Beta"):
+        self._lock = threading.Lock()
+        self.beta: "Beta" = beta
+        self.steps = 0
+
+    def step(self) -> None:
+        with self._lock:
+            self.beta.poke()  # SEED: acquires Beta._lock under Alpha._lock
+
+
+class Beta:
+    def __init__(self, alpha: "Alpha"):
+        self._lock = threading.Lock()
+        self.alpha: "Alpha" = alpha
+        self.pokes = 0
+
+    def poke(self) -> None:
+        with self._lock:
+            self.pokes += 1
+
+    def kick(self) -> None:
+        with self._lock:
+            self.alpha.step()  # SEED: acquires Alpha._lock under Beta._lock

@@ -1,0 +1,33 @@
+"""Clean twin of ``lockorder_bad``: Beta calls back into Alpha OUTSIDE
+its own lock, so the acquisition graph has one direction only and the
+``lock-order`` rule must stay silent."""
+
+import threading
+
+
+class Alpha:
+    def __init__(self, beta: "Beta"):
+        self._lock = threading.Lock()
+        self.beta: "Beta" = beta
+        self.steps = 0
+
+    def step(self) -> None:
+        with self._lock:
+            self.beta.poke()
+
+
+class Beta:
+    def __init__(self, alpha: "Alpha"):
+        self._lock = threading.Lock()
+        self.alpha: "Alpha" = alpha
+        self.pokes = 0
+
+    def poke(self) -> None:
+        with self._lock:
+            self.pokes += 1
+
+    def kick(self) -> None:
+        # Snapshot-then-call: no lock held across the foreign acquisition.
+        with self._lock:
+            self.pokes += 1
+        self.alpha.step()

@@ -1,0 +1,22 @@
+"""Seeded cross-thread race: ``value`` is written on the counter's own
+daemon thread and read from the main thread with no lock anywhere —
+the shape of the fleet ``_active`` bug.  ``cross-thread-race`` must
+report the write site."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            self.value += 1  # SEED: written on the counter thread, unlocked
+
+    def read(self) -> int:
+        return self.value

@@ -1,0 +1,24 @@
+"""Clean twin of ``race_bad``: both the counter-thread write and the
+main-thread read hold the same lock, so every access shares a lock
+group and the rule must stay silent."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                self.value += 1
+
+    def read(self) -> int:
+        with self._lock:
+            return self.value

@@ -1,0 +1,246 @@
+"""dttlint v2 whole-program concurrency rules: each seeded fixture in
+``tests/analysis_fixtures/`` is detected at its exact ``path:line``,
+each clean twin stays silent, the real tree is clean end to end, and
+deleting the engine's ``_launch_lock`` in a scratch copy makes
+``collective-launch`` fire (machine-checking the PR 7 invariant)."""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from distributed_tensorflow_tpu.analysis import (
+    default_rules,
+    load_baseline,
+    load_modules,
+    run_rules,
+    split_findings,
+)
+from distributed_tensorflow_tpu.analysis.__main__ import default_targets
+from distributed_tensorflow_tpu.analysis.concurrency import (
+    CollectiveLaunchRule,
+    CrossThreadRaceRule,
+    LockOrderRule,
+    _FACTS_CACHE,
+)
+from distributed_tensorflow_tpu.analysis.core import collect_files
+from distributed_tensorflow_tpu.analysis.sarif import sarif_dict
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = REPO_ROOT / "tests" / "analysis_fixtures"
+
+
+def seeded_lines(path: Path):
+    """Lines carrying a ``# SEED`` marker — the exact expected findings."""
+    return [i for i, line in enumerate(path.read_text().splitlines(), 1)
+            if "# SEED" in line]
+
+
+def run_rule_on(rule, path: Path, root: Path = REPO_ROOT):
+    _FACTS_CACHE.clear()  # facts are keyed per module list; stay hermetic
+    modules, errors = load_modules([path], root)
+    assert not errors, errors
+    return rule.run(modules)
+
+
+class TestSeededFixtures:
+    """Each bad fixture fires at exactly its SEED-marked lines; each
+    clean twin produces zero findings from the same rule."""
+
+    CASES = [
+        ("lockorder", LockOrderRule, "lock-order"),
+        ("blocking", LockOrderRule, "lock-order"),
+        ("race", CrossThreadRaceRule, "cross-thread-race"),
+        ("launch", CollectiveLaunchRule, "collective-launch"),
+    ]
+
+    @pytest.mark.parametrize("stem,rule_cls,rule_id",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_bad_fixture_detected_at_exact_lines(self, stem, rule_cls,
+                                                 rule_id):
+        path = FIXTURES / f"{stem}_bad.py"
+        expected = seeded_lines(path)
+        assert expected, f"{path} lost its SEED markers"
+        findings = run_rule_on(rule_cls(), path)
+        assert sorted(f.line for f in findings) == sorted(expected), [
+            f"{f.path}:{f.line} {f.message}" for f in findings]
+        relpath = path.relative_to(REPO_ROOT).as_posix()
+        for f in findings:
+            assert f.rule == rule_id
+            assert f.path == relpath
+
+    @pytest.mark.parametrize("stem,rule_cls,rule_id",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_clean_twin_is_silent(self, stem, rule_cls, rule_id):
+        findings = run_rule_on(rule_cls(), FIXTURES / f"{stem}_clean.py")
+        assert findings == [], [
+            f"{f.path}:{f.line} {f.message}" for f in findings]
+
+    def test_blocking_fixture_is_warning_tier(self):
+        findings = run_rule_on(LockOrderRule(), FIXTURES / "blocking_bad.py")
+        assert all(f.severity == "warning" for f in findings)
+
+    def test_lockorder_fixture_names_both_groups(self):
+        findings = run_rule_on(LockOrderRule(), FIXTURES / "lockorder_bad.py")
+        msgs = " ".join(f.message for f in findings)
+        assert "Alpha._lock" in msgs and "Beta._lock" in msgs
+
+
+class TestRealTreeClean:
+    """The tree-wide gate, in-process: full default targets, full rule
+    set, every finding either absent or justified in the baseline."""
+
+    def test_full_tree_zero_unjustified_findings(self):
+        _FACTS_CACHE.clear()
+        files = collect_files(default_targets(REPO_ROOT), REPO_ROOT)
+        modules, errors = load_modules(files, REPO_ROOT)
+        assert not errors, errors
+        findings = run_rules(modules, default_rules())
+        entries = load_baseline(
+            REPO_ROOT / "distributed_tensorflow_tpu" / "analysis"
+            / "baseline.json")
+        new, baselined, stale = split_findings(findings, entries)
+        assert new == [], [
+            f"{f.rule} {f.path}:{f.line} {f.message}" for f in new]
+        assert stale == [], stale
+
+
+class TestLaunchLockInvariant:
+    """Deleting PR 7's ``_launch_lock`` acquisitions in a scratch copy
+    of the tree makes ``collective-launch`` fire on engine.py — the
+    rule actually guards the invariant, not just the fixture."""
+
+    def test_removing_launch_lock_trips_rule(self, tmp_path):
+        scratch = tmp_path / "scratch"
+        shutil.copytree(
+            REPO_ROOT / "distributed_tensorflow_tpu",
+            scratch / "distributed_tensorflow_tpu",
+            ignore=shutil.ignore_patterns("__pycache__"))
+        engine = scratch / "distributed_tensorflow_tpu" / "serve" / "engine.py"
+        src = engine.read_text()
+        assert "with _launch_lock:" in src
+        engine.write_text(src.replace("with _launch_lock:", "if True:"))
+
+        _FACTS_CACHE.clear()
+        files = collect_files([scratch / "distributed_tensorflow_tpu"],
+                              scratch)
+        modules, errors = load_modules(files, scratch)
+        assert not errors, errors
+        findings = CollectiveLaunchRule().run(modules)
+        engine_hits = [f for f in findings
+                       if f.path == "distributed_tensorflow_tpu/serve/engine.py"]
+        assert engine_hits, "unlocked launches in engine.py went undetected"
+        _FACTS_CACHE.clear()
+
+    def test_real_tree_engine_is_currently_clean(self):
+        _FACTS_CACHE.clear()
+        files = collect_files([REPO_ROOT / "distributed_tensorflow_tpu"],
+                              REPO_ROOT)
+        modules, errors = load_modules(files, REPO_ROOT)
+        assert not errors, errors
+        assert CollectiveLaunchRule().run(modules) == []
+
+
+class TestCli:
+    """The new runner surface: --changed-only, --prune, stale-as-error,
+    and SARIF output."""
+
+    def _run(self, *argv, stdin=None, cwd=REPO_ROOT):
+        return subprocess.run(
+            [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+             *argv],
+            input=stdin, capture_output=True, text=True, cwd=cwd,
+            timeout=300)
+
+    def test_changed_only_reads_stdin(self):
+        listed = ("distributed_tensorflow_tpu/analysis/sarif.py\n"
+                  "docs/not-python.md\n"
+                  "distributed_tensorflow_tpu/analysis/core.py\n")
+        proc = self._run("--changed-only", stdin=listed)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "2 files" in proc.stdout
+
+    def test_changed_only_empty_input_is_clean_noop(self):
+        proc = self._run("--changed-only", stdin="")
+        assert proc.returncode == 0
+        assert "nothing to analyze" in proc.stdout
+
+    def test_changed_only_rejects_explicit_paths(self):
+        proc = self._run("--changed-only", "train.py", stdin="")
+        assert proc.returncode == 2
+
+    def test_stale_entry_errors_on_full_run_and_prune_drops_it(
+            self, tmp_path):
+        real = json.loads(
+            (REPO_ROOT / "distributed_tensorflow_tpu" / "analysis"
+             / "baseline.json").read_text())
+        real["entries"].append({
+            "rule": "lock-discipline",
+            "path": "distributed_tensorflow_tpu/serve/engine.py",
+            "code": "self.never_matches_anything = 1",
+            "justification": "stale on purpose",
+        })
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps(real))
+
+        proc = self._run("--baseline", str(bl))
+        assert proc.returncode == 1, proc.stdout
+        assert "stale baseline entry" in proc.stdout
+        assert "--prune" in proc.stdout
+
+        proc = self._run("--baseline", str(bl), "--prune")
+        assert proc.returncode == 0, proc.stdout
+        assert "pruned 1" in proc.stdout
+        kept = json.loads(bl.read_text())["entries"]
+        assert all(e["code"] != "self.never_matches_anything = 1"
+                   for e in kept)
+
+        proc = self._run("--baseline", str(bl))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_prune_refuses_partial_runs(self):
+        proc = self._run("--prune", "--rules", "lock-discipline")
+        assert proc.returncode == 2
+        assert "full default run" in proc.stderr
+
+    def test_sarif_format_on_seeded_fixture(self):
+        proc = self._run("--format=sarif", "--no-baseline",
+                         str(FIXTURES / "race_bad.py"))
+        assert proc.returncode == 1  # seeded finding present
+        log = json.loads(proc.stdout)
+        assert log["version"] == "2.1.0"
+        run = log["runs"][0]
+        assert run["tool"]["driver"]["name"] == "dttlint"
+        results = run["results"]
+        race = [r for r in results if r["ruleId"] == "cross-thread-race"]
+        assert race, results
+        loc = race[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == \
+            "tests/analysis_fixtures/race_bad.py"
+        assert loc["region"]["startLine"] in seeded_lines(
+            FIXTURES / "race_bad.py")
+        rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+        assert race[0]["ruleIndex"] == rule_ids.index("cross-thread-race")
+
+    def test_sarif_out_writes_file(self, tmp_path):
+        out = tmp_path / "report.sarif"
+        proc = self._run("--sarif-out", str(out), "--no-baseline",
+                         str(FIXTURES / "launch_clean.py"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        log = json.loads(out.read_text())
+        assert log["runs"][0]["results"] == []
+
+
+class TestSarifUnit:
+    def test_severity_maps_to_level(self):
+        from distributed_tensorflow_tpu.analysis.core import Finding
+        fs = [Finding(rule="lock-order", path="a.py", line=3,
+                      message="m", severity="warning"),
+              Finding(rule="lock-order", path="a.py", line=4,
+                      message="n")]
+        log = sarif_dict(fs, default_rules())
+        levels = [r["level"] for r in log["runs"][0]["results"]]
+        assert levels == ["warning", "error"]

@@ -278,9 +278,13 @@ class _StubWatchReplica:
 
     def __init__(self, restored_step=None):
         self.updates = []
-        self.engine = types.SimpleNamespace(
+        eng = types.SimpleNamespace(
             restored_step=restored_step, params=None,
             shard_params=lambda p: ("sharded", p))
+        # the watcher swaps weights through install_params (launch-lock
+        # serialized on the real engine); the stub just stores them
+        eng.install_params = lambda p: setattr(eng, "params", p)
+        self.engine = eng
         stub = self
 
         class _Sched:

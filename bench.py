@@ -129,7 +129,15 @@ def _serve_bench(flags):
     ``prefix_hit_rate``, ``prefill_tokens_skipped`` and
     ``ttft_speedup_prefix`` carry the prefix-caching claim, and
     ``prefix_parity`` asserts the warm run's greedy token checksum is
-    identical to the cold run's."""
+    identical to the cold run's.
+
+    The chunked-prefill A/B replays the continuous run with a
+    per-iteration ``prefill_budget``: ``tpot_p99_chunked`` /
+    ``tpot_p99_speedup_chunked`` carry the head-of-line claim, and the
+    ``chunked_*_parity`` keys assert greedy output is bit-identical
+    budget-on vs budget-off — alone, composed with prefix caching
+    (``prefill_tokens_skipped`` unchanged), and over the per-shard
+    pool."""
     import dataclasses
 
     import jax
@@ -204,16 +212,62 @@ def _serve_bench(flags):
         paged, num_blocks=0, prefix_cache=False,
         shared_prefix_len=256 if on_tpu else 64, shared_prefix_groups=2)
     prefix_warm = dataclasses.replace(prefix_cold, prefix_cache=True)
+    # Chunked-prefill A/B: a decode-heavy mix with a WHALE prompt many
+    # budgets long — the head-of-line regime chunking exists for.  The
+    # whale's prefill spreads over whale/budget iterations while
+    # already-decoding slots keep stepping every iteration, so a short
+    # request retiring mid-whale waits one chunk, not the whole prompt.
+    # The budget sits between the typical concurrent short-prompt wave
+    # (so admission prefill is NOT serialized) and the whale (so the
+    # whale IS split).  TPOT p99 carries the claim; greedy checksums
+    # must match bit-for-bit (chunking is a pure scheduling change),
+    # including composed with prefix caching and the per-shard pool.
+    # The CPU pair runs the `mini` preset on its own engine: at tiny
+    # scale every launch costs the same regardless of tokens (dispatch
+    # overhead dominates), so the whale stall chunking removes doesn't
+    # exist — mini is the smallest config where prefill compute
+    # dominates and the scheduling effect is measurable.
+    # Budget = half the whale: two chunks split the stall (the p99 gap
+    # halves) at the cost of ONE extra launch per whale — prefill cost
+    # is sublinear in tokens (fixed dispatch overhead per launch), so
+    # smaller chunks trade throughput for no further latency win.
+    budget = 384 if on_tpu else 192
+    chunk_base = dataclasses.replace(
+        continuous, steps=3 * fixed.steps,
+        preset=preset if on_tpu else "mini",
+        prompt_lens=",".join(
+            (["16,32,48"] * 4 + ["768"]) if on_tpu
+            else (["8,12,16"] * 4 + ["384"])),
+        max_new_tokens=32, min_new_tokens=8)
+    chunked = dataclasses.replace(chunk_base, prefill_budget=budget)
+    # Composition parity runs reuse the tiny-mix traffic, so they need a
+    # budget SMALLER than those prompts for chunking to engage at all.
+    parity_budget = 64 if on_tpu else 16
+    chunked_prefix = dataclasses.replace(prefix_warm,
+                                         prefill_budget=parity_budget)
+    pershard = dataclasses.replace(paged, num_blocks=0, per_shard_kv=True)
+    pershard_chunked = dataclasses.replace(pershard,
+                                           prefill_budget=parity_budget)
+    chunk_engine = engine if on_tpu else ServeEngine(
+        "gpt2", mesh=mesh, checkpoint_dir=flags.checkpoint_dir,
+        seed=fixed.seed, preset="mini")
     try:
         fixed_res = run_serve(fixed, engine=engine)
         cont_res = run_serve(continuous, engine=engine)
+        chunk_base_res = run_serve(chunk_base, engine=chunk_engine)
+        chunked_res = run_serve(chunked, engine=chunk_engine)
         paged_res = run_serve(paged, engine=engine)
         int8_res = run_serve(paged_int8, engine=engine)
         fleet_res = run_serve(fleet, engine=engine)
         prefix_cold_res = run_serve(prefix_cold, engine=engine)
         prefix_warm_res = run_serve(prefix_warm, engine=engine)
+        chunked_prefix_res = run_serve(chunked_prefix, engine=engine)
+        pershard_res = run_serve(pershard, engine=engine)
+        pershard_chunked_res = run_serve(pershard_chunked, engine=engine)
     finally:
         engine.close()
+        if chunk_engine is not engine:
+            chunk_engine.close()
     trace_events = len(tracer)
     if flags.trace_out:
         trace_events = write_chrome_trace(flags.trace_out)
@@ -276,6 +330,27 @@ def _serve_bench(flags):
             / max(prefix_warm_res["ttft_p50_ms"], 1e-9), 3),
         "prefix_parity": (prefix_warm_res["tokens_checksum"]
                           == prefix_cold_res["tokens_checksum"]),
+        "tpot_p99_ms": cont_res["tpot_p99_ms"],
+        "tpot_p99_unchunked": chunk_base_res["tpot_p99_ms"],
+        "tpot_p99_chunked": chunked_res["tpot_p99_ms"],
+        "tpot_p99_speedup_chunked": round(
+            chunk_base_res["tpot_p99_ms"]
+            / max(chunked_res["tpot_p99_ms"], 1e-9), 3),
+        "unchunked_tokens_per_sec": chunk_base_res["tokens_per_sec"],
+        "chunked_tokens_per_sec": chunked_res["tokens_per_sec"],
+        "chunked_prefill_budget": budget,
+        "chunked_prefill_chunks": chunked_res["prefill_chunks"],
+        "chunked_parity": (chunked_res["tokens_checksum"]
+                           == chunk_base_res["tokens_checksum"]),
+        "chunked_prefix_parity": (
+            chunked_prefix_res["tokens_checksum"]
+            == prefix_warm_res["tokens_checksum"]),
+        "chunked_prefix_skip_parity": (
+            chunked_prefix_res["prefill_tokens_skipped"]
+            == prefix_warm_res["prefill_tokens_skipped"]),
+        "chunked_pershard_parity": (
+            pershard_chunked_res["tokens_checksum"]
+            == pershard_res["tokens_checksum"]),
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

@@ -104,6 +104,17 @@ class GPT2Config:
         return cls(vocab_size=256, n_positions=128, d_model=64, n_layer=2,
                    n_head=4, dropout=0.0, **kw)
 
+    @classmethod
+    def mini(cls, **kw):  # CPU serve-bench scale
+        # Big enough that a long prompt's prefill COMPUTE dominates the
+        # per-launch dispatch overhead on CPU (tiny is the opposite —
+        # every launch costs about the same regardless of tokens), so
+        # scheduling effects like chunked prefill's head-of-line relief
+        # are measurable without a TPU; small enough to compile and
+        # serve a bench run in seconds.
+        return cls(vocab_size=256, n_positions=512, d_model=256, n_layer=4,
+                   n_head=8, dropout=0.0, **kw)
+
 
 @dataclasses.dataclass(frozen=True)
 class PagedKVConfig:

@@ -24,7 +24,11 @@ straight into a ``ContinuousScheduler``'s admission queue
 The client surface (submit -> Future, ``ServeOverloadedError``
 backpressure, ``stats()``, ``close()``) is unchanged, so callers swap
 scheduling disciplines without code changes; completion is out of
-submission order in both modes.
+submission order in both modes.  With the scheduler's ``prefill_budget``
+set, the continuous stats gain the chunked-prefill surface
+(``prefilling_slots``, ``prefill_backlog_tokens``, ``prefill_chunks``,
+``tpot_p50_ms``/``tpot_p99_ms``); TTFT is stamped at the request's first
+DECODED token — the final prefill chunk's output — not at admission.
 """
 
 from __future__ import annotations

@@ -56,6 +56,26 @@ Fleet extensions (``serve/fleet``):
   Composes with per-shard pools (each shard keys its own map — slots
   only index local blocks) and hot reload (the map is invalidated at
   generation install: cached K/V is params-dependent).
+- CHUNKED PREFILL — ``prefill_budget > 0`` bounds the prompt tokens
+  prefilled per iteration: a request whose remaining prompt exceeds the
+  budget is admitted into its slot but prefills one
+  ``min(remaining, budget)``-token chunk per iteration
+  (``prefill_into_slots(start_offsets=...)`` — chunk N starts where
+  chunk N-1 stopped; the last chunk may be ragged), so a whale prompt
+  never stalls the resident decode slots for more than one budget's
+  worth of prefill compute.  Slots mid-prefill are excluded from the
+  decode step's active mask; the FINAL chunk's output is the request's
+  first generated token (earlier chunks' outputs predict prompt tokens
+  the caller already has), which is where TTFT is stamped.  Chunking is
+  a pure scheduling change: the same K/V lands at the same positions,
+  so greedy output is bit-identical budget on vs off.  Prefix-cached
+  prompt tokens cost ZERO budget — the chunk walk starts past the
+  mapped blocks.  The walk serves not-yet-started requests first (one
+  small chunk starts a short prompt decoding; the whale's remaining
+  chunks overlap it), with an aging bound (``_PREFILL_AGE_LIMIT``) so
+  sustained short traffic can't starve an in-progress whale.
+  ``prefill_budget=0`` (default) keeps the one-shot whole-prompt
+  prefill.
 """
 
 from __future__ import annotations
@@ -66,7 +86,7 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -84,6 +104,11 @@ from distributed_tensorflow_tpu.serve.paged import (
 )
 
 logger = logging.getLogger(__name__)
+
+# Chunked prefill: iterations a prefill-pending slot may go chunk-less
+# (budget spent on other slots) before it jumps the walk order — bounds
+# an in-progress whale's wait under sustained new-short-prompt traffic.
+_PREFILL_AGE_LIMIT = 4
 
 
 def _continuous_instruments(registry=None):
@@ -115,6 +140,16 @@ def _continuous_instruments(registry=None):
             "dtt_kv_prefix_prefill_tokens_skipped",
             "Prompt tokens whose prefill compute a cache hit skipped",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)),
+        "prefill_chunk": r.histogram(
+            "dtt_serve_prefill_chunk_tokens",
+            "Prompt tokens prefilled per chunk (chunked prefill)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)),
+        "prefill_backlog": r.gauge(
+            "dtt_serve_prefill_backlog_tokens",
+            "Prompt tokens admitted into slots but not yet prefilled"),
+        "prefilling_slots": r.gauge(
+            "dtt_serve_prefilling_slots",
+            "Slots admitted but still prefilling their prompt"),
     })
     return out
 
@@ -147,6 +182,41 @@ class _SlotRequest:
     # Prefix caching: the prompt's chained block content keys, computed
     # once on the submitting thread (pure hashing — no allocator state).
     prefix_keys: List[bytes] = dataclasses.field(default_factory=list)
+    # Chunked prefill (loop-thread state): the next prompt position to
+    # prefill (admission sets it to the prefix-mapped start; the request
+    # is still PREFILLING while it is short of the prompt length), how
+    # many chunks have run, when the first chunk started, and how many
+    # leading tokens the prefix cache mapped (zero budget spent on them).
+    next_prefill_offset: int = 0
+    prefill_chunks: int = 0
+    prefill_started_at: Optional[float] = None
+    prefix_cached: int = 0
+    # When this request's latest token landed (first set at the final
+    # prefill chunk) — each decode step's now - last_token_at is one
+    # inter-token gap sample.
+    last_token_at: Optional[float] = None
+    # Iterations this slot sat prefill-pending without receiving a chunk
+    # (budget spent on other slots); at _PREFILL_AGE_LIMIT the slot jumps
+    # the chunk queue so a whale can't starve behind a stream of new
+    # short prompts.
+    prefill_idle: int = 0
+
+    def prefilling(self) -> bool:
+        return self.next_prefill_offset < len(self.prompt)
+
+    def chunk_priority(self) -> Tuple[bool, bool, int]:
+        """Sort key for the per-iteration chunk walk (lower = first).
+
+        Not-yet-started requests outrank in-progress ones: a new short
+        prompt needs ONE small chunk to begin decoding, while an
+        in-progress whale only moves its own (already bounded) first
+        token closer — so overlapping the shorts with the whale's
+        remaining chunks is pure throughput.  An in-progress slot that
+        has sat ``_PREFILL_AGE_LIMIT`` iterations without a chunk jumps
+        the queue, so sustained short traffic can't starve a whale.
+        Ties resolve oldest request first (deterministic)."""
+        return (self.prefill_idle < _PREFILL_AGE_LIMIT,
+                self.prefill_chunks > 0, self.rid)
 
     def done(self) -> bool:
         if len(self.tokens) >= self.max_new_tokens:
@@ -187,6 +257,12 @@ class ContinuousScheduler:
     multiple of the mesh's data-parallel extent — slot rows shard over the
     data axes).  ``max_total_len`` bounds prompt + generated length per
     slot; admission validates it per request.
+
+    ``prefill_budget > 0`` caps the prompt tokens prefilled per iteration
+    (chunked prefill — see the module docstring): long prompts prefill in
+    ``min(remaining, budget)``-token chunks interleaved with the decode
+    step instead of stalling it for one whole-prompt prefill.  Greedy
+    output is bit-identical budget on vs off.
     """
 
     def __init__(
@@ -205,6 +281,7 @@ class ContinuousScheduler:
         kv_dtype: Optional[str] = None,
         per_shard_kv: bool = False,
         prefix_cache: bool = False,
+        prefill_budget: int = 0,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -228,7 +305,12 @@ class ContinuousScheduler:
             raise ValueError(
                 "prefix_cache shares physical KV blocks through block "
                 "tables — it requires cache_mode='paged'")
+        if prefill_budget < 0:
+            raise ValueError(
+                f"prefill_budget must be >= 0 (0 = unchunked one-shot "
+                f"prefill), got {prefill_budget}")
         self.engine = engine
+        self.prefill_budget = int(prefill_budget)
         self.prefix_cache = bool(prefix_cache)
         self.num_slots = engine.bucket_rows(max(1, num_slots))
         self.max_total_len = int(max_total_len or cfg.n_positions)
@@ -323,6 +405,11 @@ class ContinuousScheduler:
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefix_tokens_skipped = 0
+        # Chunked prefill (under _lock): chunks launched, slots still
+        # mid-prefill, and the un-prefilled prompt-token backlog.
+        self._prefill_chunks = 0
+        self._prefilling = 0
+        self._prefill_backlog = 0
         self._iterations = 0
         self._decode_counter = 0  # fold_in counter for the in-step RNG
         self._occupancy_sum = 0
@@ -330,6 +417,14 @@ class ContinuousScheduler:
         self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
         self._ttft_ms: collections.deque = collections.deque(maxlen=1024)
         self._tpot_ms: collections.deque = collections.deque(maxlen=1024)
+        # Individual inter-token gaps (every decoded token's wait, across
+        # all requests) — the distribution whose tail chunked prefill
+        # bounds: unchunked, a whale prompt's whole prefill lands inside
+        # ONE unlucky gap; chunked, no gap carries more than a budget's
+        # worth of prefill.  tpot_p50/p99 come from here; tpot_mean stays
+        # the per-request mean (decode cadence per stream).
+        self._tpot_gaps_ms: collections.deque = collections.deque(
+            maxlen=4096)
         self._queue_wait_ms: collections.deque = collections.deque(maxlen=1024)
         self._obs = _continuous_instruments()
         self._obs_registry = obs_metrics.default_registry()
@@ -566,6 +661,18 @@ class ContinuousScheduler:
                                     if prefix_lookups else 0.0),
                 "prefill_tokens_skipped": float(
                     self._prefix_tokens_skipped),
+                # Gap-based TPOT percentiles (one sample per decoded
+                # token): the tail chunked prefill bounds — unlike
+                # tpot_mean_ms, whose per-request averaging washes a
+                # single whale stall out over the whole stream.
+                "tpot_p50_ms": _percentile(
+                    sorted(self._tpot_gaps_ms), 0.50),
+                "tpot_p99_ms": _percentile(
+                    sorted(self._tpot_gaps_ms), 0.99),
+                "prefill_budget": float(self.prefill_budget),
+                "prefilling_slots": float(self._prefilling),
+                "prefill_backlog_tokens": float(self._prefill_backlog),
+                "prefill_chunks": float(self._prefill_chunks),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -663,6 +770,7 @@ class ContinuousScheduler:
                             "hot reload invalidated %d prefix-cached "
                             "block(s)", dropped)
                 self._admit(admits)
+                self._prefill_step()
                 self._decode_once()
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
             logger.exception("continuous scheduler loop died")
@@ -787,57 +895,139 @@ class ContinuousScheduler:
             self._slot_shard[req.slot])
 
     def _admit(self, admits: List[_SlotRequest]) -> None:
-        """Slot-local prefill per admitted request.  Prompts are prefilled
-        one request at a time — each (1, T_prompt) program compiles once
-        per prompt length, and a single-row prefill touches only that
-        slot's rows of the resident cache."""
+        """Admission: map the cached prefix, init the chunk state machine
+        and make the request RESIDENT.  No prefill compute runs here —
+        ``_prefill_step`` spends the iteration's budget on the resident
+        prefilling slots (with ``prefill_budget=0`` the whole prompt runs
+        as a single chunk in the same iteration, the classic one-shot
+        behaviour).  The worst-case block reservation was already taken
+        under the loop lock — once, at admit — so chunk-boundary
+        allocations can never fail mid-prefill."""
         for req in admits:
-            prefill_start = time.monotonic()
-            queue_wait_s = prefill_start - req.submitted
+            admitted_at = time.monotonic()
+            queue_wait_s = admitted_at - req.submitted
             if self._tracer.enabled:
                 self._tracer.add_span(
                     "queue_wait", cat="serve", tid=req.rid,
-                    start=req.submitted, end=prefill_start,
+                    start=req.submitted, end=admitted_at,
                     args={"request_id": req.rid, "slot": req.slot})
                 if req.blocked_since is not None:
                     self._tracer.add_span(
                         "reservation_wait", cat="serve", tid=req.rid,
-                        start=req.blocked_since, end=prefill_start,
+                        start=req.blocked_since, end=admitted_at,
                         args={"request_id": req.rid,
                               "reserved_blocks": req.reserved_blocks})
+            # Prefix-cached tokens cost ZERO prefill budget: the chunk
+            # walk starts past the mapped blocks.
             start = self._map_prefix(req)
-            self._ensure_blocks(req, len(req.prompt))
-            tok_dev, self._cache = self.engine.prefill_into_slots(
-                self._cache, req.prompt[None, start:], [req.slot],
-                temperature=self.temperature, top_k=self.top_k,
-                counter=self._next_counter(), params=req.gen.params,
-                start_offsets=[start] if start else None,
-                **self._paged_call_kwargs())
-            tok = int(np.asarray(jax.device_get(tok_dev))[0])
-            req.first_token_at = time.monotonic()
-            req.tokens.append(tok)
-            self._last_tok[req.slot, 0] = tok
-            self._register_prefix(req)
-            if self._tracer.enabled:
-                self._tracer.add_span(
-                    "prefill", cat="serve", tid=req.rid,
-                    start=prefill_start, end=req.first_token_at,
-                    args={"request_id": req.rid, "slot": req.slot,
-                          "prompt_len": int(len(req.prompt)),
-                          "prefix_tokens_cached": int(start)})
+            req.next_prefill_offset = start
+            req.prefix_cached = start
+            req.prefill_started_at = admitted_at
             with self._lock:
                 self._admitted += 1
                 self._active[req.slot] = req
+                self._prefilling += 1
+                self._prefill_backlog += len(req.prompt) - start
                 self._queue_wait_ms.append(queue_wait_s * 1000.0)
                 self._obs["admissions"].inc()
                 self._obs["queue_wait"].observe(queue_wait_s)
-                self._obs["ttft"].observe(req.first_token_at - req.submitted)
                 self._obs["active_slots"].set(len(self._active))
-            logger.debug("admitted request into slot %d (prompt %d, ttft "
-                         "%.1fms)", req.slot, len(req.prompt),
-                         (req.first_token_at - req.submitted) * 1e3)
-            if req.done():  # max_new_tokens == 1 or instant eos
-                self._retire(req)
+                self._obs["prefilling_slots"].set(self._prefilling)
+                self._obs["prefill_backlog"].set(self._prefill_backlog)
+            logger.debug("admitted request into slot %d (prompt %d, "
+                         "cached %d)", req.slot, len(req.prompt), start)
+
+    def _prefill_step(self) -> None:
+        """Spend up to ``prefill_budget`` prompt tokens on the resident
+        slots still prefilling, in ``chunk_priority`` order (new requests
+        first — one small chunk starts a short decoding while a whale's
+        remaining chunks overlap it — with an aging bound so the whale
+        can't starve).  Each slot runs at most one ``min(remaining,
+        budget)``-token chunk per iteration via
+        ``prefill_into_slots(start_offsets=[offset])`` — the offset is a
+        dynamic argument, so chunk N reuses chunk N-1's compiled program
+        whenever the lengths match.  A chunk that would overrun the
+        iteration's remaining budget WAITS (no partial chunks, so the
+        compiled-shape set stays the canonical chunk sizes); the walk
+        still offers the leftover budget to later, smaller chunks.  The
+        FINAL chunk's output token is the request's first generated token
+        — earlier chunks' outputs predict prompt tokens the caller
+        already has and are discarded — so TTFT is stamped at the first
+        DECODED token, here."""
+        with self._lock:
+            # Same snapshot discipline as _decode_once: close() clears
+            # _active from another thread under the lock.
+            snapshot = dict(self._active)
+        pending = sorted((r for r in snapshot.values() if r.prefilling()),
+                         key=lambda r: r.chunk_priority())
+        if not pending:
+            return
+        budget = self.prefill_budget
+        spent = 0
+        for req in pending:
+            off = req.next_prefill_offset
+            remaining = len(req.prompt) - off
+            chunk = remaining if budget <= 0 else min(remaining, budget)
+            if budget > 0 and spent + chunk > budget:
+                req.prefill_idle += 1
+                continue
+            req.prefill_idle = 0
+            chunk_start = time.monotonic()
+            self._ensure_blocks(req, off + chunk)
+            tok_dev, self._cache = self.engine.prefill_into_slots(
+                self._cache, req.prompt[None, off:off + chunk], [req.slot],
+                temperature=self.temperature, top_k=self.top_k,
+                counter=self._next_counter(), params=req.gen.params,
+                start_offsets=[off] if off else None,
+                **self._paged_call_kwargs())
+            spent += chunk
+            req.next_prefill_offset = off + chunk
+            req.prefill_chunks += 1
+            final = not req.prefilling()
+            if final:
+                tok = int(np.asarray(jax.device_get(tok_dev))[0])
+                req.first_token_at = time.monotonic()
+                req.last_token_at = req.first_token_at
+                req.tokens.append(tok)
+                self._last_tok[req.slot, 0] = tok
+                self._register_prefix(req)
+            if self._tracer.enabled:
+                now = time.monotonic()
+                self._tracer.add_span(
+                    "prefill_chunk", cat="serve", tid=req.rid,
+                    start=chunk_start, end=now,
+                    args={"request_id": req.rid, "slot": req.slot,
+                          "offset": int(off), "chunk_tokens": int(chunk),
+                          "chunk_index": int(req.prefill_chunks - 1),
+                          "final": bool(final)})
+                if final:
+                    self._tracer.add_span(
+                        "prefill", cat="serve", tid=req.rid,
+                        start=req.prefill_started_at,
+                        end=req.first_token_at,
+                        args={"request_id": req.rid, "slot": req.slot,
+                              "prompt_len": int(len(req.prompt)),
+                              "prefix_tokens_cached": int(
+                                  req.prefix_cached),
+                              "chunks": int(req.prefill_chunks)})
+            with self._lock:
+                self._prefill_chunks += 1
+                self._prefill_backlog -= chunk
+                self._obs["prefill_chunk"].observe(chunk)
+                if final:
+                    self._prefilling -= 1
+                    self._obs["ttft"].observe(
+                        req.first_token_at - req.submitted)
+                self._obs["prefilling_slots"].set(self._prefilling)
+                self._obs["prefill_backlog"].set(self._prefill_backlog)
+            if final:
+                logger.debug(
+                    "slot %d finished prefill (prompt %d, %d chunk(s), "
+                    "ttft %.1fms)", req.slot, len(req.prompt),
+                    req.prefill_chunks,
+                    (req.first_token_at - req.submitted) * 1e3)
+                if req.done():  # max_new_tokens == 1 or instant eos
+                    self._retire(req)
 
     def _decode_once(self) -> None:
         """One iteration: a (num_slots, 1) step over all slots, then
@@ -847,14 +1037,22 @@ class ContinuousScheduler:
             # under the lock from another thread, so the loop below must
             # not re-read it after this point.
             snapshot = dict(self._active)
-        active_slots = list(snapshot)
+        # Slots still prefilling are NOT decode-active: their state
+        # advances in _prefill_step, and their cache_index rows must stay
+        # frozen at next_prefill_offset (the decode step's inactive-row
+        # garbage write lands at that position, which the next chunk
+        # overwrites — never in a mapped prefix block, which sits
+        # strictly below the offset).  req.tokens is non-empty exactly
+        # when the final chunk has run.
+        decoding = {s: r for s, r in snapshot.items() if r.tokens}
+        active_slots = list(decoding)
         if not active_slots:
             return
         iter_start = time.monotonic()
         for slot in active_slots:
             # The upcoming step writes each slot's position
             # prompt + len(tokens) - 1; cross a block boundary -> allocate.
-            req = snapshot[slot]
+            req = decoding[slot]
             self._ensure_blocks(req, len(req.prompt) + len(req.tokens))
         # Group rows by pinned weight generation: mid-reload, rows admitted
         # before the swap keep decoding on their own params — one step per
@@ -865,7 +1063,7 @@ class ContinuousScheduler:
         # own step.
         by_gen: Dict[int, List[int]] = {}
         for slot in active_slots:
-            by_gen.setdefault(snapshot[slot].gen.generation, []).append(slot)
+            by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
         toks_by_slot: Dict[int, int] = {}
         for generation in sorted(by_gen):
             slots = by_gen[generation]
@@ -875,7 +1073,7 @@ class ContinuousScheduler:
                 self._cache, self._last_tok, active,
                 temperature=self.temperature, top_k=self.top_k,
                 counter=self._next_counter(),
-                params=snapshot[slots[0]].gen.params,
+                params=decoding[slots[0]].gen.params,
                 **self._paged_call_kwargs())
             toks = np.asarray(jax.device_get(tok_dev))
             for slot in slots:
@@ -890,13 +1088,20 @@ class ContinuousScheduler:
                 start=iter_start, end=time.monotonic(),
                 args={"active_slots": len(active_slots),
                       "generations": len(by_gen)})
+        step_done = time.monotonic()
+        gaps = []
         for slot in active_slots:
-            req = snapshot[slot]
+            req = decoding[slot]
             tok = toks_by_slot[slot]
             req.tokens.append(tok)
             self._last_tok[slot, 0] = tok
+            if req.last_token_at is not None:
+                gaps.append((step_done - req.last_token_at) * 1000.0)
+            req.last_token_at = step_done
             if req.done():
                 self._retire(req)
+        with self._lock:
+            self._tpot_gaps_ms.extend(gaps)
 
     def _next_counter(self) -> int:
         with self._lock:

@@ -96,6 +96,13 @@ class ServeArgs:
     # request map those blocks from cache (refcounted, copy-on-write)
     # and prefill only the uncached suffix.
     prefix_cache: bool = False
+    # Chunked prefill: >0 bounds the prompt tokens prefilled per scheduler
+    # iteration — a long prompt spreads over several iterations (chunks of
+    # this size; ragged final chunk) while already-decoding slots keep
+    # stepping every iteration, so decode TPOT never stalls behind a whale
+    # prompt.  0 = classic one-shot prefill.  Greedy output is bit-identical
+    # either way.
+    prefill_budget: int = 0
     # Shared-prefix traffic mix: >0 prepends a system prompt of this many
     # tokens to every request, drawn from `shared_prefix_groups` distinct
     # prefixes — the workload prefix caching exists for.  0 keeps the
@@ -264,6 +271,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             max_queue_size=args.max_queue_size,
             temperature=args.temperature,
             top_k=args.top_k,
+            prefill_budget=args.prefill_budget,
             **_cache_kwargs(args),
         )
         return DynamicBatcher(iteration_level=True, scheduler=scheduler)
@@ -318,6 +326,7 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             max_queue_size=args.max_queue_size,
             temperature=args.temperature,
             top_k=args.top_k,
+            prefill_budget=args.prefill_budget,
             name=f"serve-fleet-r{i}",
             **_cache_kwargs(args),
         )
@@ -348,11 +357,17 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
         # a T-token uncached suffix will launch.
         warm_kwargs = {**_cache_kwargs(args), "prefix_cache": False} \
             if args.cache_mode == "paged" else _cache_kwargs(args)
+        # Warming with the SAME prefill_budget compiles the chunk shapes
+        # the timed run will launch: chunk lengths depend only on the
+        # remaining prompt length (the start offset is dynamic), so a
+        # donor prompt of each expected suffix length walks exactly the
+        # budget-size chunks plus its ragged final chunk.
         warm_sched = ContinuousScheduler(
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
                               max(p.shape[0] + m for p, m in payloads)),
             temperature=args.temperature, top_k=args.top_k,
+            prefill_budget=args.prefill_budget,
             **warm_kwargs)
         lengths = sorted({p.shape[0] for p, _ in payloads})
         warm_lengths = set(lengths)
@@ -501,6 +516,10 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["ttft_p50_ms"] = round(stats["ttft_p50_ms"], 3)
         out["ttft_p99_ms"] = round(stats["ttft_p99_ms"], 3)
         out["tpot_mean_ms"] = round(stats["tpot_mean_ms"], 4)
+        out["tpot_p50_ms"] = round(stats.get("tpot_p50_ms", 0.0), 4)
+        out["tpot_p99_ms"] = round(stats.get("tpot_p99_ms", 0.0), 4)
+        out["prefill_budget"] = int(args.prefill_budget)
+        out["prefill_chunks"] = int(stats.get("prefill_chunks", 0.0))
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
         if args.cache_mode == "paged":

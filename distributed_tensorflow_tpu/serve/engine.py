@@ -23,6 +23,7 @@ batch split.
 from __future__ import annotations
 
 import functools
+import inspect
 import logging
 import threading
 import time
@@ -141,6 +142,20 @@ class ServeEngine:
             model, mesh=self.mesh, **workload_overrides)
         self.model = model
         self.module = self.workload.module
+        # Fail fast on a decode-incompatible mesh: KV-cache decode runs
+        # the scanned block stack directly, which a pipeline-split mesh
+        # cannot serve — the model would only raise this deep inside its
+        # first decode apply, after params were already materialized.
+        pipe = self.mesh.shape.get("pipe", 1)
+        decodes = "decode" in inspect.signature(
+            type(self.module).__call__).parameters
+        if pipe > 1 and decodes:
+            raise ValueError(
+                f"ServeEngine cannot serve model {model!r} on a mesh with "
+                f"a 'pipe' axis of size {pipe}: KV-cache decode "
+                f"(decode=True) is unsupported under pipeline parallelism "
+                f"— re-mesh without the pipe axis (TP/DP shardings apply) "
+                f"or dedicate a pipe-free mesh slice to serving")
         self._manager: Optional[CheckpointManager] = None
         self._generate_fns: Dict[Any, Callable] = {}
         self._cache_init_fns: Dict[Any, Callable] = {}
@@ -447,11 +462,15 @@ class ServeEngine:
         ``slot_ids`` must already cover each prompt's blocks.
 
         ``start_offsets`` (n,) starts each row's prefill at that logical
-        position instead of 0 (prefix caching: ``prompts`` then carries
-        only the UNCACHED suffix, and the slot's table rows below the
-        offset must already map the cached prefix blocks).  Offsets are
-        a dynamic argument — varying them never recompiles; only the
-        suffix LENGTH is a compile-time shape.
+        position instead of 0.  Two callers rely on it: prefix caching
+        (``prompts`` carries only the UNCACHED suffix; the slot's table
+        rows below the offset must already map the cached prefix blocks)
+        and CHUNKED prefill (``prompts`` carries the next chunk of the
+        same prompt; earlier chunks' K/V already sits below the offset —
+        in the slot's dense rows or its allocated blocks — and the
+        causal mask attends over it, so dense mode composes too).
+        Offsets are a dynamic argument — varying them never recompiles;
+        only the chunk/suffix LENGTH is a compile-time shape.
 
         ``params`` overrides ``self.params`` for this call (hot weight
         reload: the scheduler pins each request to the param generation it
@@ -470,10 +489,6 @@ class ServeEngine:
             raise ValueError(
                 f"start_offsets must be ({prompts.shape[0]},), "
                 f"got {starts.shape}")
-        if starts.any() and paged is None:
-            raise ValueError(
-                "start_offsets > 0 requires the paged cache (prefix "
-                "blocks are mapped through the block table)")
         key = ("slot_prefill", float(temperature), int(top_k), paged)
         base = rng if rng is not None else self._sample_rng
         bt = None if block_tables is None else np.asarray(

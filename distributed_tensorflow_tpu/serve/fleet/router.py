@@ -59,16 +59,21 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     Queue depth dominates (a backed-up replica is the worst place to
     send work), then slot occupancy, then KV-pool pressure — the three
     saturate at 4, 2 and 1 respectively so a full queue always outranks
-    a full pool.
+    a full pool.  A slot still PREFILLING its prompt (chunked prefill)
+    counts double: it is already in ``active_slots`` but, unlike a
+    decoding slot, it will also consume the next iterations' prefill
+    budget — a replica mid-whale is busier than its occupancy shows.
     """
     depth = stats.get("queue_depth", 0.0)
     cap = max(1.0, stats.get("capacity", 1.0))
     active = stats.get("active_slots", 0.0)
     slots = max(1.0, stats.get("num_slots", 1.0))
+    prefilling = stats.get("prefilling_slots", 0.0)
     total = stats.get("blocks_total", 0.0)
     free = stats.get("blocks_free", 0.0)
     kv_pressure = (1.0 - free / total) if total else 0.0
-    return 4.0 * depth / cap + 2.0 * active / slots + kv_pressure
+    return (4.0 * depth / cap + 2.0 * (active + prefilling) / slots
+            + kv_pressure)
 
 
 class Replica:
@@ -215,12 +220,14 @@ class FleetRouter:
         "failed", "num_slots", "active_slots", "admitted", "retired",
         "iterations", "kv_hbm_bytes", "blocks_total", "blocks_free",
         "blocks_in_use", "blocks_high_water", "last_occupancy",
+        "prefilling_slots", "prefill_backlog_tokens", "prefill_chunks",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
-        "tpot_mean_ms", "queue_wait_p50_ms", "queue_wait_p99_ms",
+        "tpot_mean_ms", "tpot_p50_ms", "tpot_p99_ms",
+        "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
-        "param_generation",
+        "param_generation", "prefill_budget",
     )
 
     def stats(self) -> Dict[str, float]:

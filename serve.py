@@ -17,6 +17,8 @@ Examples:
     python serve.py --model=gpt2 --continuous --cache_mode=paged \
         --prefix_cache --shared_prefix_len=256 \
         --shared_prefix_groups=4      # prefix caching over shared prompts
+    python serve.py --model=gpt2 --continuous --prefill_budget=32 \
+        --prompt_lens=8,8,8,512       # chunked prefill under whale prompts
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
@@ -109,6 +111,14 @@ def parse_args(argv=None):
                         "requests sharing full leading prompt blocks map "
                         "them from cache (refcounted, copy-on-write) and "
                         "prefill only the uncached suffix")
+    p.add_argument("--prefill_budget", type=int,
+                   default=defaults.prefill_budget,
+                   help="continuous mode: max prompt tokens prefilled per "
+                        "scheduler iteration — long prompts spread over "
+                        "several iterations (chunked prefill) while "
+                        "decoding slots keep stepping, so decode TPOT "
+                        "never stalls behind a whale prompt; greedy "
+                        "output is bit-identical (0 = one-shot prefill)")
     p.add_argument("--shared_prefix_len", type=int,
                    default=defaults.shared_prefix_len,
                    help="traffic mix: prepend a shared system prompt of "

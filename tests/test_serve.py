@@ -357,6 +357,17 @@ def gpt2_engine(request):
 
 
 class TestServeEngine:
+    def test_pipe_mesh_rejected_at_construction(self, devices8):
+        """A decode-capable model on a pipeline-split mesh must fail at
+        ServeEngine CONSTRUCTION, naming the mesh axis — not deep inside
+        the first decode apply after params already materialized."""
+        from distributed_tensorflow_tpu.cluster import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(data=4, pipe=2), devices8)
+        with pytest.raises(ValueError,
+                           match=r"'pipe' axis of size 2.*pipeline"):
+            ServeEngine("gpt2", mesh=mesh, preset="tiny")
+
     def test_generate_shape_dtype_determinism(self, gpt2_engine):
         vocab = gpt2_engine.module.cfg.vocab_size
         prompts = np.asarray(

@@ -106,6 +106,22 @@ def test_serve_entrypoint_prefix_cache_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_chunked_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--prefill_budget=8", "--num_slots=8",
+                "--steps=12", "--prompt_lens=6,8,40", "--max_new_tokens=6",
+                "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    assert out["completed"] == 12
+    assert out["prefill_budget"] == 8
+    # Every 40-token prompt takes 5 chunks, so chunks > requests.
+    assert out["prefill_chunks"] > 12
+    assert out["tpot_p99_ms"] >= out["tpot_p50_ms"] >= 0
+    assert len(out["tokens_checksum"]) == 16
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
@@ -140,3 +156,17 @@ def test_bench_serve_mode_prints_one_json_line():
     assert out["prefix_hit_rate"] > 0
     assert out["prefill_tokens_skipped"] > 0
     assert out["prefix_parity"] is True
+    # the chunked-prefill claim: the skewed whale mix's inter-token gap
+    # p99 improves (or at worst matches), the whale actually chunked, and
+    # greedy output is bit-identical budget on vs off — alone and
+    # composed with the prefix cache and the per-shard pool
+    for key in ("tpot_p99_unchunked", "tpot_p99_chunked",
+                "unchunked_tokens_per_sec", "chunked_tokens_per_sec",
+                "chunked_prefill_budget"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["tpot_p99_speedup_chunked"] >= 1.0
+    assert out["chunked_prefill_chunks"] > 0
+    assert out["chunked_parity"] is True
+    assert out["chunked_prefix_parity"] is True
+    assert out["chunked_prefix_skip_parity"] is True
+    assert out["chunked_pershard_parity"] is True

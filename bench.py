@@ -22,6 +22,7 @@ counter into a constant base key on device (``in_step_rng`` — no host-side
 """
 
 import argparse
+import gc
 import json
 import os
 import statistics
@@ -137,7 +138,15 @@ def _serve_bench(flags):
     ``chunked_*_parity`` keys assert greedy output is bit-identical
     budget-on vs budget-off — alone, composed with prefix caching
     (``prefill_tokens_skipped`` unchanged), and over the per-shard
-    pool."""
+    pool.
+
+    The megastep A/B replays a decode-heavy mix with K=8 decode
+    iterations fused into one compiled program vs the classic K=1
+    per-token launch (same engine, same traffic):
+    ``megastep_tokens_per_sec`` / ``megastep_speedup`` carry the
+    dispatch-amortization claim and ``megastep_parity`` asserts the
+    greedy token checksums are bit-identical — megastep is a pure
+    dispatch-granularity change."""
     import dataclasses
 
     import jax
@@ -248,6 +257,24 @@ def _serve_bench(flags):
     pershard = dataclasses.replace(paged, num_blocks=0, per_shard_kv=True)
     pershard_chunked = dataclasses.replace(pershard,
                                            prefill_budget=parity_budget)
+    # Megastep A/B: decode-heavy traffic (no whale — prefill time would
+    # dilute the decode-dispatch fraction under measurement), long
+    # horizons so each request decodes many steps.  K=8 pays one host
+    # dispatch + one (num_slots, 8) fetch per 8 tokens; K=1 is the
+    # classic per-token launch.  Runs on the chunk engine (mini preset
+    # on CPU): dispatch overhead is a tax at every scale, and mini is
+    # the smallest config whose step compute makes the timing stable.
+    # Horizon 33 is UNIFORM and deliberate: the first generated token
+    # comes from prefill, so every request decodes exactly 32 = 4*K
+    # tokens and retires ON a megastep boundary — the throughput claim
+    # measures dispatch amortization, not ragged-tail masking (masking
+    # correctness is the parity suite's job, not the bench's).
+    mega_base = dataclasses.replace(
+        continuous, steps=2 * fixed.steps,
+        preset=preset if on_tpu else "mini",
+        prompt_lens="16,32,48" if on_tpu else "8,12,16",
+        max_new_tokens=33, min_new_tokens=33)
+    mega8 = dataclasses.replace(mega_base, megastep=8)
     chunk_engine = engine if on_tpu else ServeEngine(
         "gpt2", mesh=mesh, checkpoint_dir=flags.checkpoint_dir,
         seed=fixed.seed, preset="mini")
@@ -256,6 +283,41 @@ def _serve_bench(flags):
         cont_res = run_serve(continuous, engine=engine)
         chunk_base_res = run_serve(chunk_base, engine=chunk_engine)
         chunked_res = run_serve(chunked, engine=chunk_engine)
+        # The megastep claim is a few-percent dispatch-amortization
+        # effect on the CPU smoke (one core; a mini step is
+        # compute-bound), which sits inside single-run scheduler noise.
+        # Measure it like a perf harness, not a smoke: discard one
+        # FULL-SIZE run per arm first (the K=8 scan program compiles in
+        # its warmup, and on this host the first timed run after
+        # compile is reliably ~15% slow regardless of arm — a short
+        # warmup does not absorb that), collect garbage before each
+        # timed run, interleave base/K=8 pairs, and report
+        # best-of-N(mega) / best-of-N(base).  Best-of-N is the classic
+        # min-time statistic: on an otherwise idle single core,
+        # interference only ever subtracts throughput, so the fastest
+        # run per arm is the least-disturbed one, and taking the max of
+        # BOTH arms keeps the ratio unbiased under symmetric noise.
+        mega_base_runs, mega8_runs = [], []
+        for i in range(4):
+            # Alternate which arm goes first so within-process drift
+            # (allocator warmth, page cache) doesn't always favor the
+            # same arm.  Pair 0 is the discarded full-size warmup.
+            order = ((mega_base, mega8), (mega8, mega_base))[i % 2]
+            for cfg in order:
+                gc.collect()
+                res = run_serve(cfg, engine=chunk_engine)
+                if i == 0:
+                    continue
+                (mega_base_runs if cfg is mega_base
+                 else mega8_runs).append(res)
+        mega_base_res = max(
+            mega_base_runs, key=lambda r: r["tokens_per_sec"])
+        mega8_res = max(mega8_runs, key=lambda r: r["tokens_per_sec"])
+        mega_speedup = (mega8_res["tokens_per_sec"]
+                        / max(mega_base_res["tokens_per_sec"], 1e-9))
+        mega_parity = all(
+            r["tokens_checksum"] == mega_base_runs[0]["tokens_checksum"]
+            for r in mega_base_runs + mega8_runs)
         paged_res = run_serve(paged, engine=engine)
         int8_res = run_serve(paged_int8, engine=engine)
         fleet_res = run_serve(fleet, engine=engine)
@@ -351,6 +413,13 @@ def _serve_bench(flags):
         "chunked_pershard_parity": (
             pershard_chunked_res["tokens_checksum"]
             == pershard_res["tokens_checksum"]),
+        "megastep": mega8_res["megastep"],
+        "megastep_tokens_per_sec": mega8_res["tokens_per_sec"],
+        "megastep_base_tokens_per_sec": mega_base_res["tokens_per_sec"],
+        "megastep_speedup": round(mega_speedup, 3),
+        "megastep_parity": mega_parity,
+        "megastep_launches": mega8_res["megastep_launches"],
+        "megastep_base_launches": mega_base_res["megastep_launches"],
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

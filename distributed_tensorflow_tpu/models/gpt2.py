@@ -202,6 +202,26 @@ class PagedKVConfig:
     def max_blocks_per_slot(self, total_len: int) -> int:
         return self.blocks_for(total_len)
 
+    def blocks_for_megastep(self, prompt_len: int, generated: int,
+                            steps: int, max_new_tokens: int) -> int:
+        """Physical blocks a ``steps``-iteration fused decode (megastep)
+        needs mapped BEFORE it launches.  The scan applies the cache
+        ``steps`` times inside one program, so the scatter targets for
+        every inner position must already resolve through the block
+        table — there is no host boundary mid-scan to allocate at.
+        Coverage clamps to the admission reservation
+        (``prompt_len + max_new_tokens - 1``): a row whose horizon ends
+        mid-megastep is alive-gated on device (its ``cache_index`` row
+        freezes), so the positions past its horizon are only ever
+        written as masked garbage — behind the frozen index, where the
+        causal mask never admits them — and need no block of their own.
+        """
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        covered = min(prompt_len + generated + steps - 1,
+                      prompt_len + max_new_tokens - 1)
+        return self.blocks_for(covered)
+
     @property
     def usable_blocks(self) -> int:
         """Blocks available to requests (pool minus the trash blocks)."""

@@ -76,6 +76,25 @@ Fleet extensions (``serve/fleet``):
   sustained short traffic can't starve an in-progress whale.
   ``prefill_budget=0`` (default) keeps the one-shot whole-prompt
   prefill.
+- MEGASTEP DECODE — ``megastep K > 1`` fuses K decode iterations into
+  ONE compiled program (``engine.decode_megastep``: a ``lax.scan`` over
+  the inner step) so the host pays one dispatch + one fetch per K
+  tokens instead of per token.  Slot decode state rides the device
+  between inner steps: sampling folds the same per-token counters in on
+  device, a row that hits its eos or horizon at inner step j < K stops
+  advancing there (its index rows gate exactly like the single-step
+  active mask; the host trims its tail columns), and paged block
+  tables are precomputed for all K positions at megastep start
+  (``_ensure_blocks`` covers ``len(prompt)+len(tokens)+K-1`` once,
+  clamped to the admission reservation).  The scheduler admits and
+  retires only at megastep boundaries; ``toks`` come back as one
+  ``(num_slots, K)`` fetch.  Greedy output is bit-identical K on vs
+  off — megastep is a pure dispatch-granularity change, the same
+  scheduling-only contract as chunked prefill.  TPOT attribution for
+  K > 1: the host only observes the megastep-boundary fetch timestamp,
+  so the K gaps inside a megastep are synthesized as equal shares of
+  (fetch time - the slot's previous token time) — per-token cadence
+  inside the device loop is invisible to the host by design.
 """
 
 from __future__ import annotations
@@ -101,6 +120,7 @@ from distributed_tensorflow_tpu.serve.batcher import (
 from distributed_tensorflow_tpu.serve.paged import (
     BlockAllocator,
     chain_block_keys,
+    megastep_coverage,
 )
 
 logger = logging.getLogger(__name__)
@@ -150,6 +170,14 @@ def _continuous_instruments(registry=None):
         "prefilling_slots": r.gauge(
             "dtt_serve_prefilling_slots",
             "Slots admitted but still prefilling their prompt"),
+        "megastep_size": r.histogram(
+            "dtt_serve_megastep_size",
+            "Inner decode steps fused per compiled decode launch",
+            buckets=(1, 2, 4, 8, 16, 32, 64)),
+        "megastep_amortized": r.counter(
+            "dtt_serve_megastep_launches_amortized_total",
+            "Tokens fetched beyond one per decode launch (host "
+            "dispatches the megastep/batch amortized away)"),
     })
     return out
 
@@ -282,6 +310,7 @@ class ContinuousScheduler:
         per_shard_kv: bool = False,
         prefix_cache: bool = False,
         prefill_budget: int = 0,
+        megastep: int = 1,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -309,7 +338,12 @@ class ContinuousScheduler:
             raise ValueError(
                 f"prefill_budget must be >= 0 (0 = unchunked one-shot "
                 f"prefill), got {prefill_budget}")
+        if megastep < 1:
+            raise ValueError(
+                f"megastep must be >= 1 (1 = one decode iteration per "
+                f"compiled launch, the classic path), got {megastep}")
         self.engine = engine
+        self.megastep = int(megastep)
         self.prefill_budget = int(prefill_budget)
         self.prefix_cache = bool(prefix_cache)
         self.num_slots = engine.bucket_rows(max(1, num_slots))
@@ -380,6 +414,14 @@ class ContinuousScheduler:
         self._free: List[int] = list(range(self.num_slots))
         self._active: Dict[int, _SlotRequest] = {}
         self._last_tok = np.zeros((self.num_slots, 1), np.int32)
+        # Device-resident decode inputs (loop-thread state): the previous
+        # launch's on-device token vector, chained into the next launch
+        # with zero host work, and the replicated device copy of the
+        # block tables.  Either is None when the host copy is newer —
+        # _last_tok after a prefill's host write, _block_tables after any
+        # table mutation (allocation growth, prefix map, retire reset).
+        self._dev_last_tok = None
+        self._dev_block_tables = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: "collections.deque[_SlotRequest]" = collections.deque()
@@ -410,6 +452,11 @@ class ContinuousScheduler:
         self._prefill_chunks = 0
         self._prefilling = 0
         self._prefill_backlog = 0
+        # Megastep (under _lock): decode launches issued and tokens
+        # fetched from them — tokens/launches is the realized
+        # amortization, ~K * live generations when slots stay busy.
+        self._megastep_launches = 0
+        self._megastep_tokens = 0
         self._iterations = 0
         self._decode_counter = 0  # fold_in counter for the in-step RNG
         self._occupancy_sum = 0
@@ -673,6 +720,9 @@ class ContinuousScheduler:
                 "prefilling_slots": float(self._prefilling),
                 "prefill_backlog_tokens": float(self._prefill_backlog),
                 "prefill_chunks": float(self._prefill_chunks),
+                "megastep": float(self.megastep),
+                "megastep_launches": float(self._megastep_launches),
+                "megastep_tokens": float(self._megastep_tokens),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -758,6 +808,9 @@ class ContinuousScheduler:
                         # start its reservation-wait span.
                         self._queue[0].blocked_since = time.monotonic()
                     self._obs["depth"].set(len(self._queue))
+                    refill = (self.megastep > 1 and bool(admits)
+                              and bool(self._queue) and bool(self._free)
+                              and not self._draining)
                 if gen_swapped and self.prefix_cache:
                     # Cached K/V is a function of the weights that wrote
                     # it: a new generation drops every key (before this
@@ -771,6 +824,21 @@ class ContinuousScheduler:
                             "block(s)", dropped)
                 self._admit(admits)
                 self._prefill_step()
+                if refill:
+                    # Megastep admission alignment: a K-step launch pins
+                    # its rows for K iterations, so a request that missed
+                    # this boundary by milliseconds would decode phase-
+                    # shifted from its wave forever, wasting masked
+                    # slot-steps at every retirement.  When this iteration
+                    # admitted something and (as of the locked admission
+                    # pass above) the queue and free slots were both
+                    # non-empty, keep admitting and prefilling, THEN
+                    # launch the fused step — rows admitted together
+                    # advance and retire together.  Never taken when this
+                    # iteration admitted nothing (a blocked head of line
+                    # must not starve decode), and a no-op for K=1, whose
+                    # admission granularity is already one step.
+                    continue
                 self._decode_once()
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
             logger.exception("continuous scheduler loop died")
@@ -829,6 +897,7 @@ class ContinuousScheduler:
         fresh = self._allocator.allocate(
             needed - len(blocks), slot=req.slot, shard=shard)
         self._block_tables[req.slot, len(blocks):needed] = fresh
+        self._dev_block_tables = None  # host table grew
         blocks.extend(fresh)
         with self._lock:
             release = min(req.reserved_blocks, len(fresh))
@@ -836,9 +905,16 @@ class ContinuousScheduler:
             self._reserved[shard] -= release
 
     def _paged_call_kwargs(self) -> Dict[str, Any]:
+        """Paged kwargs for the slot programs, with the block tables kept
+        DEVICE-resident: the replicated copy is re-put only after a host
+        table mutation (``_dev_block_tables`` invalidated), not per
+        launch.  Loop-thread only, like every table mutator."""
         if self.paged is None:
             return {}
-        return {"paged": self.paged, "block_tables": self._block_tables}
+        if self._dev_block_tables is None:
+            self._dev_block_tables = self.engine.put_replicated(
+                self._block_tables)
+        return {"paged": self.paged, "block_tables": self._dev_block_tables}
 
     def _map_prefix(self, req: _SlotRequest) -> int:
         """Map the longest cached prefix into ``req``'s slot (loop thread,
@@ -864,6 +940,7 @@ class ContinuousScheduler:
         m = len(blocks)
         if m:
             self._block_tables[req.slot, :m] = blocks
+            self._dev_block_tables = None  # host table changed
             self._slot_blocks[req.slot].extend(blocks)
         start = m * self.block_size
         with self._lock:
@@ -990,6 +1067,7 @@ class ContinuousScheduler:
                 req.last_token_at = req.first_token_at
                 req.tokens.append(tok)
                 self._last_tok[req.slot, 0] = tok
+                self._dev_last_tok = None  # host token vector is newer
                 self._register_prefix(req)
             if self._tracer.enabled:
                 now = time.monotonic()
@@ -1029,9 +1107,8 @@ class ContinuousScheduler:
                 if req.done():  # max_new_tokens == 1 or instant eos
                     self._retire(req)
 
-    def _decode_once(self) -> None:
-        """One iteration: a (num_slots, 1) step over all slots, then
-        retirement of every row that hit its eos or horizon."""
+    def _decode_snapshot(self) -> Dict[int, _SlotRequest]:
+        """Slot -> request map of the rows that decode THIS iteration."""
         with self._lock:
             # Snapshot the slot->request map: close() clears self._active
             # under the lock from another thread, so the loop below must
@@ -1044,7 +1121,17 @@ class ContinuousScheduler:
         # overwrites — never in a mapped prefix block, which sits
         # strictly below the offset).  req.tokens is non-empty exactly
         # when the final chunk has run.
-        decoding = {s: r for s, r in snapshot.items() if r.tokens}
+        return {s: r for s, r in snapshot.items() if r.tokens}
+
+    def _decode_once(self) -> None:
+        """One iteration: a (num_slots, 1) step over all slots, then
+        retirement of every row that hit its eos or horizon.  With
+        ``megastep > 1`` the iteration is one K-step fused program
+        instead."""
+        if self.megastep > 1:
+            self._decode_megastep_once()
+            return
+        decoding = self._decode_snapshot()
         active_slots = list(decoding)
         if not active_slots:
             return
@@ -1064,17 +1151,33 @@ class ContinuousScheduler:
         by_gen: Dict[int, List[int]] = {}
         for slot in active_slots:
             by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
-        toks_by_slot: Dict[int, int] = {}
+        # Issue EVERY generation's launch before fetching any tokens: the
+        # launches chain through the donated cache asynchronously, so a
+        # two-generation iteration mid-reload no longer serializes on a
+        # blocking device_get between its groups.  Each group reads the
+        # same pre-iteration token vector (device-resident when the last
+        # iteration's copy is still valid).
+        last_in = (self._dev_last_tok if self._dev_last_tok is not None
+                   else self._last_tok)
+        launches: List[Tuple[List[int], Any]] = []
         for generation in sorted(by_gen):
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
             tok_dev, self._cache = self.engine.decode_slots(
-                self._cache, self._last_tok, active,
+                self._cache, last_in, active,
                 temperature=self.temperature, top_k=self.top_k,
                 counter=self._next_counter(),
                 params=decoding[slots[0]].gen.params,
                 **self._paged_call_kwargs())
+            launches.append((slots, tok_dev))
+        # Chain the device tokens into the next iteration only when ONE
+        # generation ran: the single-step program's output is not
+        # alive-gated, so with two groups each output carries garbage at
+        # the other group's rows.
+        self._dev_last_tok = launches[0][1] if len(launches) == 1 else None
+        toks_by_slot: Dict[int, int] = {}
+        for slots, tok_dev in launches:
             toks = np.asarray(jax.device_get(tok_dev))
             for slot in slots:
                 toks_by_slot[slot] = int(toks[slot])
@@ -1102,11 +1205,126 @@ class ContinuousScheduler:
                 self._retire(req)
         with self._lock:
             self._tpot_gaps_ms.extend(gaps)
+            self._megastep_launches += len(launches)
+            self._megastep_tokens += len(active_slots)
+            for _ in launches:
+                self._obs["megastep_size"].observe(1)
+            saved = len(active_slots) - len(launches)
+            if saved > 0:
+                self._obs["megastep_amortized"].inc(saved)
 
-    def _next_counter(self) -> int:
+    def _decode_megastep_once(self) -> None:
+        """One megastep iteration: K fused decode steps in ONE launch per
+        live generation, then ONE (num_slots, K) fetch per launch and
+        retirement at the boundary.
+
+        Block tables are precomputed for all K positions up front —
+        coverage clamped to the request's admission reservation, so a
+        row whose horizon ends mid-megastep never allocates past what
+        admission promised (its one past-horizon garbage write lands in
+        its own last block or the trash block, behind the frozen index
+        either way).  The host trims each row's fetched tokens with the
+        same ``req.done()`` walk that retires it, so a row finishing at
+        inner step j < K contributes exactly its first j+1 tokens —
+        bit-identical to the K=1 path — and nothing after its eos leaks
+        into ``req.tokens``.
+
+        TPOT for K > 1 (see the module docstring): the host observes one
+        timestamp per megastep, so a slot's n fetched tokens each get an
+        equal 1/n share of (fetch time - previous token time) as their
+        synthesized inter-token gap.
+        """
+        decoding = self._decode_snapshot()
+        active_slots = list(decoding)
+        if not active_slots:
+            return
+        K = self.megastep
+        iter_start = time.monotonic()
+        horizon = np.zeros((self.num_slots,), np.int32)
+        eos_rows = np.full((self.num_slots,), -1, np.int32)
+        for slot in active_slots:
+            req = decoding[slot]
+            horizon[slot] = req.max_new_tokens - len(req.tokens)
+            if req.eos_token is not None:
+                eos_rows[slot] = req.eos_token
+            # Cover all K upcoming positions once, at megastep start —
+            # never past the admission reservation (a short-horizon row
+            # stops advancing on device before it would need more).
+            self._ensure_blocks(req, megastep_coverage(
+                len(req.prompt), len(req.tokens), K, req.max_new_tokens))
+        by_gen: Dict[int, List[int]] = {}
+        for slot in active_slots:
+            by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
+        # The megastep carry IS alive-gated, so chaining it through
+        # sequential generation groups is exact: group 2's rows ride
+        # through group 1's scan untouched, and the final carry holds
+        # every row's true last token — a valid device-resident input
+        # for the next iteration unconditionally.
+        carry = (self._dev_last_tok if self._dev_last_tok is not None
+                 else self._last_tok)
+        launches: List[Tuple[List[int], Any]] = []
+        for generation in sorted(by_gen):
+            slots = by_gen[generation]
+            active = np.zeros((self.num_slots,), bool)
+            active[slots] = True
+            toks_dev, carry, self._cache = self.engine.decode_megastep(
+                self._cache, carry, active, horizon, steps=K,
+                eos_rows=eos_rows,
+                temperature=self.temperature, top_k=self.top_k,
+                counter=self._next_counter(K),
+                params=decoding[slots[0]].gen.params,
+                **self._paged_call_kwargs())
+            launches.append((slots, toks_dev))
+        self._dev_last_tok = carry
         with self._lock:
-            self._decode_counter += 1
-            return self._decode_counter
+            self._iterations += 1
+            self._occupancy_sum += len(active_slots)
+            self._last_occupancy = len(active_slots)
+        fetched = [(slots, np.asarray(jax.device_get(toks_dev)))
+                   for slots, toks_dev in launches]
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "iteration", cat="serve", tid=0,
+                start=iter_start, end=time.monotonic(),
+                args={"active_slots": len(active_slots),
+                      "generations": len(by_gen), "megastep": K})
+        step_done = time.monotonic()
+        gaps: List[float] = []
+        appended = 0
+        for slots, toks in fetched:
+            for slot in slots:
+                req = decoding[slot]
+                n = 0
+                for j in range(K):
+                    if req.done():
+                        break  # trim the dead row's tail columns
+                    req.tokens.append(int(toks[slot, j]))
+                    n += 1
+                appended += n
+                self._last_tok[slot, 0] = req.tokens[-1]
+                if n and req.last_token_at is not None:
+                    per = (step_done - req.last_token_at) * 1000.0 / n
+                    gaps.extend([per] * n)
+                req.last_token_at = step_done
+                if req.done():
+                    self._retire(req)
+        with self._lock:
+            self._tpot_gaps_ms.extend(gaps)
+            self._megastep_launches += len(launches)
+            self._megastep_tokens += appended
+            for _ in launches:
+                self._obs["megastep_size"].observe(K)
+            saved = appended - len(launches)
+            if saved > 0:
+                self._obs["megastep_amortized"].inc(saved)
+
+    def _next_counter(self, count: int = 1) -> int:
+        """Reserve ``count`` consecutive in-step RNG counters and return
+        the FIRST — the megastep folds ``counter + j`` in per inner step,
+        burning exactly the per-token counters the K=1 loop would."""
+        with self._lock:
+            self._decode_counter += count
+            return self._decode_counter - count + 1
 
     def _retire(self, req: _SlotRequest) -> None:
         req.finished_at = time.monotonic()
@@ -1132,6 +1350,7 @@ class ContinuousScheduler:
                 self._slot_blocks[req.slot] = []
             self._block_tables[req.slot, :] = self._allocator.trash_block(
                 self._slot_shard[req.slot])
+            self._dev_block_tables = None  # host table reset
         else:
             used = self.paged_equivalent_blocks
         with self._lock:

@@ -103,6 +103,13 @@ class ServeArgs:
     # prompt.  0 = classic one-shot prefill.  Greedy output is bit-identical
     # either way.
     prefill_budget: int = 0
+    # Megastep decode: K > 1 fuses K decode iterations into ONE compiled
+    # program (lax.scan on device) — one host dispatch + one
+    # (num_slots, K) fetch per K tokens.  Rows hitting their eos/horizon
+    # mid-megastep stop advancing on device and are trimmed on host, so
+    # greedy output is bit-identical K on vs off.  1 = classic
+    # one-launch-per-token path.
+    megastep: int = 1
     # Shared-prefix traffic mix: >0 prepends a system prompt of this many
     # tokens to every request, drawn from `shared_prefix_groups` distinct
     # prefixes — the workload prefix caching exists for.  0 keeps the
@@ -272,6 +279,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             temperature=args.temperature,
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
+            megastep=args.megastep,
             **_cache_kwargs(args),
         )
         return DynamicBatcher(iteration_level=True, scheduler=scheduler)
@@ -327,6 +335,7 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             temperature=args.temperature,
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
+            megastep=args.megastep,
             name=f"serve-fleet-r{i}",
             **_cache_kwargs(args),
         )
@@ -362,12 +371,15 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
         # remaining prompt length (the start offset is dynamic), so a
         # donor prompt of each expected suffix length walks exactly the
         # budget-size chunks plus its ragged final chunk.
+        # Same megastep too: the K-step scan is its own compiled program
+        # (keyed on K), so the timed run must not pay its compile.
         warm_sched = ContinuousScheduler(
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
                               max(p.shape[0] + m for p, m in payloads)),
             temperature=args.temperature, top_k=args.top_k,
             prefill_budget=args.prefill_budget,
+            megastep=args.megastep,
             **warm_kwargs)
         lengths = sorted({p.shape[0] for p, _ in payloads})
         warm_lengths = set(lengths)
@@ -520,6 +532,9 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["tpot_p99_ms"] = round(stats.get("tpot_p99_ms", 0.0), 4)
         out["prefill_budget"] = int(args.prefill_budget)
         out["prefill_chunks"] = int(stats.get("prefill_chunks", 0.0))
+        out["megastep"] = int(args.megastep)
+        out["megastep_launches"] = int(stats.get("megastep_launches", 0.0))
+        out["megastep_tokens"] = int(stats.get("megastep_tokens", 0.0))
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
         if args.cache_mode == "paged":

@@ -74,6 +74,9 @@ def _engine_instruments(registry=None):
         "decode_step": r.histogram(
             "dtt_serve_decode_step_seconds",
             "Host-side slot-decode dispatch duration"),
+        "megastep": r.histogram(
+            "dtt_serve_megastep_seconds",
+            "Host-side megastep dispatch duration (K fused decode steps)"),
     }
 
 
@@ -491,8 +494,9 @@ class ServeEngine:
                 f"got {starts.shape}")
         key = ("slot_prefill", float(temperature), int(top_k), paged)
         base = rng if rng is not None else self._sample_rng
-        bt = None if block_tables is None else np.asarray(
-            block_tables, np.int32)
+        bt = block_tables
+        if bt is not None and not isinstance(bt, jax.Array):
+            bt = np.asarray(bt, np.int32)
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
@@ -509,6 +513,10 @@ class ServeEngine:
 
     def _decode_slots_apply(self, temperature, top_k, paged, params, cache,
                             tokens, active, block_tables, rng, counter):
+        if tokens.ndim == 1:
+            # Accept the (num_slots,) device output of a previous step /
+            # megastep directly — chaining it costs zero host work.
+            tokens = tokens[:, None]
         num_slots = tokens.shape[0]
         slots = jnp.arange(num_slots, dtype=jnp.int32)
         logits, mutated = self.module.apply(
@@ -550,13 +558,18 @@ class ServeEngine:
 
         ``params`` overrides ``self.params`` for this call (hot reload:
         rows admitted before a weight swap keep decoding on their own
-        generation — same avals/shardings, so no recompile)."""
+        generation — same avals/shardings, so no recompile).
+
+        ``last_tokens`` and ``block_tables`` may already be device arrays
+        (the scheduler keeps both resident between iterations); host
+        arrays are transferred as before, so the slow path still works."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         key = ("slot_decode", float(temperature), int(top_k), paged)
         base = rng if rng is not None else self._sample_rng
-        bt = None if block_tables is None else np.asarray(
-            block_tables, np.int32)
+        bt = block_tables
+        if bt is not None and not isinstance(bt, jax.Array):
+            bt = np.asarray(bt, np.int32)
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
@@ -565,13 +578,140 @@ class ServeEngine:
                     functools.partial(self._decode_slots_apply,
                                       float(temperature), int(top_k), paged),
                     donate_argnums=(1,))
-            tokens_dev = jax.device_put(
-                np.asarray(last_tokens, np.int32),
-                batch_sharding(self.mesh))
+            tokens_dev = last_tokens
+            if not isinstance(tokens_dev, jax.Array):
+                tokens_dev = jax.device_put(
+                    np.asarray(tokens_dev, np.int32),
+                    batch_sharding(self.mesh))
             out = self._generate_fns[key](
                 self.params if params is None else params, cache,
                 tokens_dev, np.asarray(active, bool), bt, base, counter)
         self._obs["decode_step"].observe(time.perf_counter() - t0)
+        return out
+
+    def put_replicated(self, arr) -> jax.Array:
+        """Device-put a host array fully replicated over the mesh — the
+        scheduler's device-resident block-table cache.  Runs under the
+        launch lock (a transfer is a device op; fleet replicas share the
+        device set)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        with _launch_lock:
+            return jax.device_put(
+                np.asarray(arr),
+                NamedSharding(self.mesh, PartitionSpec()))
+
+    def _megastep_apply(self, steps, temperature, top_k, paged, params,
+                        cache, tokens, active, horizon, eos_rows,
+                        block_tables, rng, counter):
+        """K fused decode iterations as ONE program: ``lax.scan`` over the
+        inner step with the whole per-slot decode state in the carry.
+
+        Carry: (cache, last token (num_slots,), alive mask, remaining
+        horizon).  A row is alive while it is ``active``, has horizon
+        left, and has not emitted its eos; a dead row's token stops
+        advancing (``jnp.where`` keeps the old one) and its
+        ``cache_index``/``position`` rows are gated exactly like the
+        single-step path, so a row finishing at inner step j < K is
+        byte-identical to having stopped the loop there.  Sampling folds
+        ``counter + j`` into the base key per inner step — the SAME
+        per-token keys the K=1 loop would burn, so sampled output is
+        reproducible across megastep sizes too.
+        """
+        num_slots = tokens.shape[0]
+        slots = jnp.arange(num_slots, dtype=jnp.int32)
+
+        def _inner(carry, j):
+            cache, tok, alive, left = carry
+            logits, mutated = self.module.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, slot_ids=slots, mutable=["cache"],
+                **self._paged_kwargs(paged, block_tables),
+            )
+
+            def _gate(path, new, old):
+                name = (path[-1].key if hasattr(path[-1], "key")
+                        else str(path[-1]))
+                if name in ("cache_index", "position"):
+                    act = alive if new.ndim == 1 else alive[None, :]
+                    return jnp.where(act, new, old)
+                return new
+
+            gated = jax.tree_util.tree_map_with_path(
+                _gate, mutated["cache"], cache)
+            nxt = _select_next(logits[:, -1, :], rng, counter + j,
+                               temperature, top_k)
+            tok_next = jnp.where(alive, nxt, tok)
+            hit_eos = (eos_rows >= 0) & (tok_next == eos_rows)
+            left_next = jnp.where(alive, left - 1, left)
+            alive_next = alive & ~hit_eos & (left_next > 0)
+            return (gated, tok_next, alive_next, left_next), tok_next
+
+        init = (cache, tokens,
+                active & (horizon > 0), horizon)
+        (cache, tok_final, _, _), toks = jax.lax.scan(
+            _inner, init, jnp.arange(steps, dtype=jnp.uint32))
+        # (K, num_slots) -> (num_slots, K): one fetch per megastep.
+        return jnp.swapaxes(toks, 0, 1), tok_final, cache
+
+    def decode_megastep(self, cache: PyTree, last_tokens, active: np.ndarray,
+                        horizon: np.ndarray, *, steps: int,
+                        eos_rows=None, temperature: float = 0.0,
+                        top_k: int = 0, rng=None, counter: int = 0,
+                        paged=None, block_tables=None, params=None):
+        """K decode iterations in ONE compiled program (``lax.scan`` over
+        the step).  Returns (tokens (num_slots, K), final token
+        (num_slots,), updated cache); the cache is donated through the
+        call.
+
+        ``horizon`` (num_slots,) int32 is each slot's remaining token
+        budget; a row stops advancing once it runs out or emits its eos
+        (``eos_rows`` (num_slots,) int32, -1 = no eos for that row), and
+        the host trims the tail columns of its output row.  The final
+        token is taken from the GATED carry, so it is each row's true
+        last live token — valid to chain into the next megastep for every
+        row, including those that died mid-scan.
+
+        Paged mode requires the caller to have precomputed block-table
+        coverage for all K positions up front (reservation-at-admit
+        guarantees the blocks exist); dead and inactive rows keep
+        scattering into positions past their frozen index or into the
+        trash block, never into a live request's K/V.
+
+        ``steps=1`` compiles a one-iteration scan — same math as
+        ``decode_slots``, used only when callers want a uniform K
+        interface.  The scheduler routes K=1 through ``decode_slots``."""
+        if (paged is None) != (block_tables is None):
+            raise ValueError("paged and block_tables go together")
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"megastep steps must be >= 1, got {steps}")
+        key = ("slot_megastep", steps, float(temperature), int(top_k), paged)
+        base = rng if rng is not None else self._sample_rng
+        bt = block_tables
+        if bt is not None and not isinstance(bt, jax.Array):
+            bt = np.asarray(bt, np.int32)
+        n = len(active)
+        eos = (np.full((n,), -1, np.int32) if eos_rows is None
+               else np.asarray(eos_rows, np.int32))
+        t0 = time.perf_counter()
+        with _launch_lock:
+            if key not in self._generate_fns:
+                self._obs["compiles"].labels(kind="slot_megastep").inc()
+                self._generate_fns[key] = jax.jit(
+                    functools.partial(self._megastep_apply, steps,
+                                      float(temperature), int(top_k), paged),
+                    donate_argnums=(1,))
+            tokens_dev = last_tokens
+            if not isinstance(tokens_dev, jax.Array):
+                tokens_dev = jax.device_put(
+                    np.asarray(tokens_dev, np.int32).reshape(-1),
+                    batch_sharding(self.mesh))
+            out = self._generate_fns[key](
+                self.params if params is None else params, cache,
+                tokens_dev, np.asarray(active, bool),
+                np.asarray(horizon, np.int32), eos, bt, base, counter)
+        self._obs["megastep"].observe(time.perf_counter() - t0)
         return out
 
     def generate(self, prompts: np.ndarray, max_new_tokens: int, *,

@@ -63,6 +63,14 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     counts double: it is already in ``active_slots`` but, unlike a
     decoding slot, it will also consume the next iterations' prefill
     budget — a replica mid-whale is busier than its occupancy shows.
+
+    Megastep decode stretches the queue-depth term: a replica running
+    K fused decode steps per iteration admits (and retires) only at
+    megastep boundaries, so a queued request there waits ~K plain steps
+    before its slot even opens — its queue is effectively deeper than
+    the count shows.  The scale saturates at 2x so one huge K cannot
+    drown the occupancy/KV signals; homogeneous fleets (every replica
+    the same K) keep identical rankings, megastep or not.
     """
     depth = stats.get("queue_depth", 0.0)
     cap = max(1.0, stats.get("capacity", 1.0))
@@ -72,7 +80,10 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     total = stats.get("blocks_total", 0.0)
     free = stats.get("blocks_free", 0.0)
     kv_pressure = (1.0 - free / total) if total else 0.0
-    return (4.0 * depth / cap + 2.0 * (active + prefilling) / slots
+    mega = max(1.0, stats.get("megastep", 1.0))
+    boundary_scale = min(2.0, 1.0 + (mega - 1.0) / 8.0)
+    return (4.0 * depth / cap * boundary_scale
+            + 2.0 * (active + prefilling) / slots
             + kv_pressure)
 
 
@@ -221,13 +232,14 @@ class FleetRouter:
         "iterations", "kv_hbm_bytes", "blocks_total", "blocks_free",
         "blocks_in_use", "blocks_high_water", "last_occupancy",
         "prefilling_slots", "prefill_backlog_tokens", "prefill_chunks",
+        "megastep_launches", "megastep_tokens",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
         "tpot_mean_ms", "tpot_p50_ms", "tpot_p99_ms",
         "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
-        "param_generation", "prefill_budget",
+        "param_generation", "prefill_budget", "megastep",
     )
 
     def stats(self) -> Dict[str, float]:

@@ -127,6 +127,23 @@ def chain_block_keys(tokens, block_size: int) -> List[bytes]:
     return keys
 
 
+def megastep_coverage(prompt_len: int, generated: int, steps: int,
+                      max_new_tokens: int) -> int:
+    """K/V positions a megastep's block tables must cover, precomputed
+    ONCE at megastep start: the ``steps`` inner iterations write
+    positions ``prompt_len + generated - 1 .. + steps - 1``, clamped to
+    the request's admission reservation (``prompt_len + max_new_tokens
+    - 1`` — the last generated token never re-enters the cache).  The
+    clamp is what keeps a short-horizon row from allocating past what
+    admission promised: the row stops advancing on device before it
+    would need the uncovered positions, and its one past-horizon
+    garbage write lands behind its frozen index."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    return min(prompt_len + generated + steps - 1,
+               prompt_len + max_new_tokens - 1)
+
+
 class BlockExhaustedError(RuntimeError):
     """Raised when an allocation is requested that the pool cannot satisfy.
 

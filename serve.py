@@ -19,6 +19,8 @@ Examples:
         --shared_prefix_groups=4      # prefix caching over shared prompts
     python serve.py --model=gpt2 --continuous --prefill_budget=32 \
         --prompt_lens=8,8,8,512       # chunked prefill under whale prompts
+    python serve.py --model=gpt2 --continuous --megastep=8 \
+        --max_new_tokens=32           # K fused decode steps per dispatch
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
@@ -119,6 +121,13 @@ def parse_args(argv=None):
                         "decoding slots keep stepping, so decode TPOT "
                         "never stalls behind a whale prompt; greedy "
                         "output is bit-identical (0 = one-shot prefill)")
+    p.add_argument("--megastep", type=int, default=defaults.megastep,
+                   help="continuous mode: fuse this many decode iterations "
+                        "into ONE compiled program (on-device lax.scan) — "
+                        "one host dispatch + one fetch per K tokens; rows "
+                        "finishing mid-megastep stop on device and trim on "
+                        "host, so greedy output is bit-identical to "
+                        "--megastep=1 (the classic per-token launch)")
     p.add_argument("--shared_prefix_len", type=int,
                    default=defaults.shared_prefix_len,
                    help="traffic mix: prepend a shared system prompt of "

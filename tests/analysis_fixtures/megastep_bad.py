@@ -1,0 +1,32 @@
+"""Seeded unlocked megastep launch: the K-step fused decode program
+(a ``lax.scan`` over the decode iteration, cached in a program dict
+keyed on K) dispatched from the scheduler's worker thread with no
+module-level launch lock.  Two replicas scanning concurrently deadlock
+in the XLA collective rendezvous just like single-step decode — the
+scan body runs K collectives back-to-back, so the window is K times
+wider.  ``collective-launch`` must flag the dispatch site."""
+
+import threading
+
+import jax
+
+
+class MiniEngine:
+    def __init__(self):
+        self._programs = {}
+        self._programs["megastep"] = jax.jit(lambda tok: tok)
+
+    def decode_megastep(self, tok):
+        return self._programs["megastep"](tok)  # SEED: scan launch without a launch lock
+
+
+class Scheduler:
+    def __init__(self, engine: "MiniEngine"):
+        self.engine: "MiniEngine" = engine
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        self.engine.decode_megastep(None)

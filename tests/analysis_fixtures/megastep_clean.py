@@ -1,0 +1,31 @@
+"""Clean twin of ``megastep_bad``: the K-step scan dispatch holds the
+module-level launch lock (the ``serve.engine._launch_lock`` pattern),
+serializing fused-decode launches across scheduler threads."""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class MiniEngine:
+    def __init__(self):
+        self._programs = {}
+        self._programs["megastep"] = jax.jit(lambda tok: tok)
+
+    def decode_megastep(self, tok):
+        with _launch_lock:
+            return self._programs["megastep"](tok)
+
+
+class Scheduler:
+    def __init__(self, engine: "MiniEngine"):
+        self.engine: "MiniEngine" = engine
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        self.engine.decode_megastep(None)

@@ -55,6 +55,7 @@ class TestSeededFixtures:
         ("blocking", LockOrderRule, "lock-order"),
         ("race", CrossThreadRaceRule, "cross-thread-race"),
         ("launch", CollectiveLaunchRule, "collective-launch"),
+        ("megastep", CollectiveLaunchRule, "collective-launch"),
     ]
 
     @pytest.mark.parametrize("stem,rule_cls,rule_id",

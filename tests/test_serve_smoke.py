@@ -122,6 +122,23 @@ def test_serve_entrypoint_chunked_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_megastep_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--megastep=4", "--num_slots=8",
+                "--steps=12", "--prompt_lens=6,8", "--max_new_tokens=6",
+                "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    assert out["completed"] == 12
+    assert out["megastep"] == 4
+    # One fused launch covers up to K tokens per slot: strictly fewer
+    # launches than decoded tokens.
+    assert 0 < out["megastep_launches"] < out["megastep_tokens"]
+    assert out["tpot_p99_ms"] >= out["tpot_p50_ms"] >= 0
+    assert len(out["tokens_checksum"]) == 16
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
@@ -170,3 +187,14 @@ def test_bench_serve_mode_prints_one_json_line():
     assert out["chunked_prefix_parity"] is True
     assert out["chunked_prefix_skip_parity"] is True
     assert out["chunked_pershard_parity"] is True
+    # the megastep claim: K fused decode steps per dispatch beat (or at
+    # worst match) the per-token launch on the same traffic, at the same
+    # greedy checksum
+    for key in ("megastep", "megastep_tokens_per_sec",
+                "megastep_base_tokens_per_sec", "megastep_launches",
+                "megastep_base_launches"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["megastep"] == 8
+    assert out["megastep_parity"] is True
+    assert out["megastep_speedup"] >= 1.0
+    assert out["megastep_launches"] < out["megastep_base_launches"]

@@ -146,7 +146,18 @@ def _serve_bench(flags):
     ``megastep_tokens_per_sec`` / ``megastep_speedup`` carry the
     dispatch-amortization claim and ``megastep_parity`` asserts the
     greedy token checksums are bit-identical — megastep is a pure
-    dispatch-granularity change."""
+    dispatch-granularity change.
+
+    The speculative-decoding A/B replays a repetitive decode-heavy mix
+    (prompts tiled from a short motif — the structured workload
+    prompt-lookup drafting wins on) with ``spec_k=4`` vs spec off:
+    ``spec_speedup`` is the STEPS-PER-TOKEN ratio (launches per
+    generated token, off / on — deterministic, not a timing race; > 1
+    means the verifier emitted more than one token per launch),
+    ``spec_acceptance_rate`` the drafter's realized yield, and
+    ``spec_parity`` plus the ``spec_*_parity`` composition keys
+    (chunked prefill, prefix cache, megastep) assert greedy output is
+    bit-identical spec on vs off."""
     import dataclasses
 
     import jax
@@ -275,6 +286,30 @@ def _serve_bench(flags):
         prompt_lens="16,32,48" if on_tpu else "8,12,16",
         max_new_tokens=33, min_new_tokens=33)
     mega8 = dataclasses.replace(mega_base, megastep=8)
+    # Speculative-decoding A/B: the megastep mix made REPETITIVE —
+    # every prompt tiles a 4-token motif, so the greedy continuation
+    # cycles and the prompt-lookup drafter keeps finding its n-gram in
+    # the slot's own history.  Decode-heavy uniform horizon for the
+    # same reason as the megastep arm: the claim is launches per
+    # generated token (steps-per-token), which is deterministic — the
+    # base arm pays exactly 1 launch/token, the spec arm pays
+    # 1/(tokens-per-launch) < 1 whenever drafts are accepted.  These
+    # arms run on the MAIN engine (tiny preset on CPU), not the mini
+    # chunk engine: steps-per-token needs no compute-bound step to be
+    # stable (it counts launches, not seconds), and the (num_slots,
+    # k+1) verify is a different compiled program than the
+    # (num_slots, 1) step, so a bf16 cache can round a near-degenerate
+    # argmax tie differently between them — random-init mini hits such
+    # a tie on this motif mix; tiny is flip-free, deterministic per
+    # build, the same standing the dense-vs-paged parity runs have.
+    spec_base = dataclasses.replace(
+        continuous, steps=2 * fixed.steps,
+        prompt_lens="16,32,48" if on_tpu else "8,12,16",
+        prompt_period=4, max_new_tokens=33, min_new_tokens=33)
+    spec4 = dataclasses.replace(spec_base, spec_k=4)
+    spec_chunked = dataclasses.replace(spec4, prefill_budget=8)
+    spec_mega = dataclasses.replace(spec4, megastep=4)
+    spec_prefix = dataclasses.replace(prefix_warm, spec_k=4)
     chunk_engine = engine if on_tpu else ServeEngine(
         "gpt2", mesh=mesh, checkpoint_dir=flags.checkpoint_dir,
         seed=fixed.seed, preset="mini")
@@ -318,6 +353,10 @@ def _serve_bench(flags):
         mega_parity = all(
             r["tokens_checksum"] == mega_base_runs[0]["tokens_checksum"]
             for r in mega_base_runs + mega8_runs)
+        spec_base_res = run_serve(spec_base, engine=engine)
+        spec4_res = run_serve(spec4, engine=engine)
+        spec_chunked_res = run_serve(spec_chunked, engine=engine)
+        spec_mega_res = run_serve(spec_mega, engine=engine)
         paged_res = run_serve(paged, engine=engine)
         int8_res = run_serve(paged_int8, engine=engine)
         fleet_res = run_serve(fleet, engine=engine)
@@ -326,6 +365,7 @@ def _serve_bench(flags):
         chunked_prefix_res = run_serve(chunked_prefix, engine=engine)
         pershard_res = run_serve(pershard, engine=engine)
         pershard_chunked_res = run_serve(pershard_chunked, engine=engine)
+        spec_prefix_res = run_serve(spec_prefix, engine=engine)
     finally:
         engine.close()
         if chunk_engine is not engine:
@@ -420,6 +460,39 @@ def _serve_bench(flags):
         "megastep_parity": mega_parity,
         "megastep_launches": mega8_res["megastep_launches"],
         "megastep_base_launches": mega_base_res["megastep_launches"],
+        "spec_k": spec4_res["spec_k"],
+        "spec_tokens_per_sec": spec4_res["tokens_per_sec"],
+        "spec_base_tokens_per_sec": spec_base_res["tokens_per_sec"],
+        # Steps-per-token: decode launches per generated token.  The
+        # base arm is exactly 1.0 by construction; the spec arm drops
+        # below it whenever the verifier accepts drafts.  The ratio is
+        # the dispatch-amortization claim in a timing-free form.
+        "spec_base_steps_per_token": round(
+            spec_base_res["megastep_launches"]
+            / max(spec_base_res["megastep_tokens"], 1), 4),
+        "spec_steps_per_token": round(
+            spec4_res["megastep_launches"]
+            / max(spec4_res["megastep_tokens"], 1), 4),
+        "spec_speedup": round(
+            (spec_base_res["megastep_launches"]
+             / max(spec_base_res["megastep_tokens"], 1))
+            / max(spec4_res["megastep_launches"]
+                  / max(spec4_res["megastep_tokens"], 1), 1e-9), 3),
+        "spec_parity": (spec4_res["tokens_checksum"]
+                        == spec_base_res["tokens_checksum"]),
+        "spec_acceptance_rate": spec4_res["spec_acceptance_rate"],
+        "spec_launches": spec4_res["spec_launches"],
+        "spec_drafted": spec4_res["spec_drafted"],
+        "spec_accepted": spec4_res["spec_accepted"],
+        "spec_chunked_parity": (
+            spec_chunked_res["tokens_checksum"]
+            == spec_base_res["tokens_checksum"]),
+        "spec_megastep_parity": (
+            spec_mega_res["tokens_checksum"]
+            == spec_base_res["tokens_checksum"]),
+        "spec_prefix_parity": (
+            spec_prefix_res["tokens_checksum"]
+            == prefix_warm_res["tokens_checksum"]),
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

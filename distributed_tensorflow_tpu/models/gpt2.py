@@ -222,6 +222,21 @@ class PagedKVConfig:
                       prompt_len + max_new_tokens - 1)
         return self.blocks_for(covered)
 
+    def blocks_for_spec(self, prompt_len: int, generated: int,
+                        draft_len: int, max_new_tokens: int) -> int:
+        """Physical blocks a speculative verify launch needs mapped
+        BEFORE it runs: the (1 + draft_len)-token forward scatters K/V
+        for the last emitted token plus every draft position in ONE
+        program, so all of them must already resolve through the block
+        table — exactly the megastep precondition with
+        ``steps = draft_len + 1``, including the clamp to the admission
+        reservation (positions past the horizon are only ever written as
+        masked garbage behind the rolled-back index)."""
+        if draft_len < 0:
+            raise ValueError(f"draft_len must be >= 0, got {draft_len}")
+        return self.blocks_for_megastep(
+            prompt_len, generated, draft_len + 1, max_new_tokens)
+
     @property
     def usable_blocks(self) -> int:
         """Blocks available to requests (pool minus the trash blocks)."""

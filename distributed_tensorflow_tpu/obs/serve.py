@@ -113,6 +113,22 @@ class ServeMonitorHook(Hook):
                     int(s.get("block_size", 0)),
                     s.get("kv_hbm_bytes", 0.0) / 2**20,
                 )
+            if s.get("spec_k", 0):
+                # Speculative decoding: drafter yield and verify
+                # amortization — tok/launch > 1 is the win over the
+                # one-token-per-launch classic path.
+                logger.info(
+                    "serve @ %d: spec k=%d drafted=%d accepted=%d "
+                    "accept_rate=%.2f launches=%d emitted=%d "
+                    "tok/launch=%.2f",
+                    step, int(s.get("spec_k", 0)),
+                    int(s.get("spec_drafted", 0)),
+                    int(s.get("spec_accepted", 0)),
+                    s.get("spec_acceptance_rate", 0.0),
+                    int(s.get("spec_launches", 0)),
+                    int(s.get("spec_emitted", 0)),
+                    s.get("spec_tokens_per_launch", 0.0),
+                )
         else:
             logger.info(
                 "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "

@@ -77,9 +77,11 @@ Fleet extensions (``serve/fleet``):
   ``prefill_budget=0`` (default) keeps the one-shot whole-prompt
   prefill.
 - MEGASTEP DECODE — ``megastep K > 1`` fuses K decode iterations into
-  ONE compiled program (``engine.decode_megastep``: a ``lax.scan`` over
-  the inner step) so the host pays one dispatch + one fetch per K
-  tokens instead of per token.  Slot decode state rides the device
+  ONE compiled program (``engine.decode_megastep``: a bounded
+  ``lax.while_loop`` over the inner step that ALSO exits early once
+  every row is dead, so an all-eos megastep stops paying for its
+  remaining masked no-op steps) so the host pays one dispatch + one
+  fetch per K tokens instead of per token.  Slot decode state rides the device
   between inner steps: sampling folds the same per-token counters in on
   device, a row that hits its eos or horizon at inner step j < K stops
   advancing there (its index rows gate exactly like the single-step
@@ -95,6 +97,37 @@ Fleet extensions (``serve/fleet``):
   so the K gaps inside a megastep are synthesized as equal shares of
   (fetch time - the slot's previous token time) — per-token cadence
   inside the device loop is invisible to the host by design.
+- SPECULATIVE DECODING — ``spec_k >= 1`` turns each decode iteration
+  into draft-and-verify: an n-gram prompt-lookup drafter (NO second
+  model — the last up-to-``spec_ngram`` tokens of each slot's own
+  prompt+output history are matched against that history's earlier
+  occurrences, and the continuation after the latest match proposes up
+  to ``spec_k`` draft tokens) feeds ONE ``(num_slots, spec_k+1)``
+  verify forward (``engine.verify_slots``) that scores the last token
+  plus every draft in a single launch.  Each row keeps its longest
+  draft prefix that agrees with the per-position target tokens plus
+  one bonus/correction target — between 1 and ``spec_k + 1`` tokens
+  per launch per slot — and its ``cache_index``/``position`` advance
+  by exactly the kept length (per-slot variable advance; rejected
+  drafts' K/V stays masked behind the rolled-back index).  Greedy
+  targets are the exact greedy tokens, so greedy output is
+  bit-identical spec on vs off (the standing parity oracle); sampled
+  targets are drawn with the SAME per-token ``fold_in`` counters the
+  sequential loop would burn (unconsumed counters are refunded after
+  the launch), so sampled output stays distribution-exact — with
+  single-stream traffic, token-identical spec on vs off.  Iterations
+  where NO slot has a draft fall through to the plain decode step (or
+  the megastep when ``megastep > 1``) — a degenerate k=0 verify
+  program is never built; slots without a draft in a drafting
+  iteration ride the verify launch with ``draft_len 0`` and advance by
+  one token, exactly a plain decode step.  Composes with chunked
+  prefill (prefilling slots are inactive-masked as ever), prefix
+  caching (drafts only read host history; block coverage clamps to the
+  admission reservation via ``spec_coverage``) and hot reload (one
+  verify launch per pinned generation).  The win is fewer sequential
+  launches per generated token on repetitive/structured text —
+  ``spec_emitted / spec_launches`` tokens per launch against the plain
+  path's one.
 """
 
 from __future__ import annotations
@@ -121,6 +154,7 @@ from distributed_tensorflow_tpu.serve.paged import (
     BlockAllocator,
     chain_block_keys,
     megastep_coverage,
+    spec_coverage,
 )
 
 logger = logging.getLogger(__name__)
@@ -178,6 +212,21 @@ def _continuous_instruments(registry=None):
             "dtt_serve_megastep_launches_amortized_total",
             "Tokens fetched beyond one per decode launch (host "
             "dispatches the megastep/batch amortized away)"),
+        "spec_drafted": r.counter(
+            "dtt_serve_spec_drafted_total",
+            "Draft tokens proposed by the n-gram prompt-lookup drafter"),
+        "spec_accepted": r.counter(
+            "dtt_serve_spec_accepted_total",
+            "Draft tokens accepted by the k-token verify step"),
+        "spec_accept_rate": r.histogram(
+            "dtt_serve_spec_acceptance_rate",
+            "Per-verify-launch fraction of drafted tokens accepted",
+            buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)),
+        "spec_accepted_len": r.histogram(
+            "dtt_serve_spec_accepted_tokens",
+            "Tokens emitted per slot per verify launch (accepted "
+            "drafts + the bonus/correction token)",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 32)),
     })
     return out
 
@@ -311,6 +360,8 @@ class ContinuousScheduler:
         prefix_cache: bool = False,
         prefill_budget: int = 0,
         megastep: int = 1,
+        spec_k: Optional[int] = None,
+        spec_ngram: int = 3,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -342,8 +393,19 @@ class ContinuousScheduler:
             raise ValueError(
                 f"megastep must be >= 1 (1 = one decode iteration per "
                 f"compiled launch, the classic path), got {megastep}")
+        if spec_k is not None and spec_k < 1:
+            raise ValueError(
+                f"spec_k must be >= 1 when set (None/unset disables "
+                f"speculative decoding; a k=0 verify would just be the "
+                f"plain decode step), got {spec_k}")
+        if spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1 (longest history n-gram the "
+                f"prompt-lookup drafter matches), got {spec_ngram}")
         self.engine = engine
         self.megastep = int(megastep)
+        self.spec_k = int(spec_k) if spec_k is not None else 0
+        self.spec_ngram = int(spec_ngram)
         self.prefill_budget = int(prefill_budget)
         self.prefix_cache = bool(prefix_cache)
         self.num_slots = engine.bucket_rows(max(1, num_slots))
@@ -360,7 +422,13 @@ class ContinuousScheduler:
 
             if per_shard_kv:
                 shards = max(1, engine.data_parallelism)
-            per_slot = -(-self.max_total_len // self.block_size)
+            # spec_k tail slack: the verify program's width is fixed at
+            # k+1, so its pad columns write up to spec_k positions past
+            # a full slot's last real index.  Widening the table keeps
+            # those positions on trash-pointing entries instead of
+            # letting the lookup clamp onto the slot's last real block.
+            per_slot = -(-(self.max_total_len + self.spec_k)
+                         // self.block_size)
             if num_blocks is None:
                 # Safe default: full capacity (every slot at max length)
                 # plus the trash block(s) — no savings until sized down,
@@ -401,8 +469,14 @@ class ContinuousScheduler:
             self._block_tables = None
             self._slot_blocks = {}
             self._slot_shard = [0] * self.num_slots
+            # spec_k tail slack, same reason as the paged table above:
+            # without it the vmapped ``dynamic_update_slice`` CLAMPS a
+            # near-the-end k+1-wide verify write backward, silently
+            # overwriting the last real K/V rows (caught as an
+            # end-of-stream parity break when max_total_len is sized
+            # exactly to prompt + max_new_tokens).
             self._cache = engine.init_slot_cache(
-                self.num_slots, self.max_total_len)
+                self.num_slots, self.max_total_len + self.spec_k)
         self.kv_hbm_bytes = int(engine.cache_hbm_bytes(self._cache))
         self.kv_hbm_bytes_per_shard = int(
             engine.cache_hbm_bytes_per_shard(self._cache))
@@ -457,6 +531,16 @@ class ContinuousScheduler:
         # amortization, ~K * live generations when slots stay busy.
         self._megastep_launches = 0
         self._megastep_tokens = 0
+        # Megastep early exit: inner steps the while_loop actually ran
+        # (vs launches * K had every megastep ridden out its full span).
+        self._megastep_effective_steps = 0
+        # Speculative decoding (under _lock): verify launches, draft
+        # tokens proposed / accepted, and tokens emitted by the verify
+        # path (accepted drafts + the per-slot bonus/correction token).
+        self._spec_launches = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_emitted = 0
         self._iterations = 0
         self._decode_counter = 0  # fold_in counter for the in-step RNG
         self._occupancy_sum = 0
@@ -723,6 +807,19 @@ class ContinuousScheduler:
                 "megastep": float(self.megastep),
                 "megastep_launches": float(self._megastep_launches),
                 "megastep_tokens": float(self._megastep_tokens),
+                "megastep_effective_steps": float(
+                    self._megastep_effective_steps),
+                "spec_k": float(self.spec_k),
+                "spec_launches": float(self._spec_launches),
+                "spec_drafted": float(self._spec_drafted),
+                "spec_accepted": float(self._spec_accepted),
+                "spec_emitted": float(self._spec_emitted),
+                "spec_acceptance_rate": (
+                    self._spec_accepted / self._spec_drafted
+                    if self._spec_drafted else 0.0),
+                "spec_tokens_per_launch": (
+                    self._spec_emitted / self._spec_launches
+                    if self._spec_launches else 0.0),
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -1127,7 +1224,13 @@ class ContinuousScheduler:
         """One iteration: a (num_slots, 1) step over all slots, then
         retirement of every row that hit its eos or horizon.  With
         ``megastep > 1`` the iteration is one K-step fused program
-        instead."""
+        instead.  With ``spec_k >= 1`` the iteration is a draft-and-
+        verify step whenever ANY slot drafted; iterations where no slot
+        has a draft fall through HERE — to the plain step or the
+        megastep — so a degenerate k=0 verify program is never built or
+        cached."""
+        if self.spec_k and self._decode_spec_once():
+            return
         if self.megastep > 1:
             self._decode_megastep_once()
             return
@@ -1267,21 +1370,23 @@ class ContinuousScheduler:
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
-            toks_dev, carry, self._cache = self.engine.decode_megastep(
-                self._cache, carry, active, horizon, steps=K,
-                eos_rows=eos_rows,
-                temperature=self.temperature, top_k=self.top_k,
-                counter=self._next_counter(K),
-                params=decoding[slots[0]].gen.params,
-                **self._paged_call_kwargs())
-            launches.append((slots, toks_dev))
+            toks_dev, carry, steps_dev, self._cache = (
+                self.engine.decode_megastep(
+                    self._cache, carry, active, horizon, steps=K,
+                    eos_rows=eos_rows,
+                    temperature=self.temperature, top_k=self.top_k,
+                    counter=self._next_counter(K),
+                    params=decoding[slots[0]].gen.params,
+                    **self._paged_call_kwargs()))
+            launches.append((slots, toks_dev, steps_dev))
         self._dev_last_tok = carry
         with self._lock:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
             self._last_occupancy = len(active_slots)
-        fetched = [(slots, np.asarray(jax.device_get(toks_dev)))
-                   for slots, toks_dev in launches]
+        fetched = [(slots, np.asarray(jax.device_get(toks_dev)),
+                    int(jax.device_get(steps_dev)))
+                   for slots, toks_dev, steps_dev in launches]
         if self._tracer.enabled:
             self._tracer.add_span(
                 "iteration", cat="serve", tid=0,
@@ -1291,7 +1396,9 @@ class ContinuousScheduler:
         step_done = time.monotonic()
         gaps: List[float] = []
         appended = 0
-        for slots, toks in fetched:
+        effective = 0
+        for slots, toks, steps_run in fetched:
+            effective += steps_run
             for slot in slots:
                 req = decoding[slot]
                 n = 0
@@ -1312,11 +1419,192 @@ class ContinuousScheduler:
             self._tpot_gaps_ms.extend(gaps)
             self._megastep_launches += len(launches)
             self._megastep_tokens += appended
+            self._megastep_effective_steps += effective
             for _ in launches:
                 self._obs["megastep_size"].observe(K)
             saved = appended - len(launches)
             if saved > 0:
                 self._obs["megastep_amortized"].inc(saved)
+
+    def _draft_for(self, req: _SlotRequest) -> Optional[np.ndarray]:
+        """n-gram prompt-lookup drafter: match the request's last n tokens
+        (n from ``spec_ngram`` down to 1) against earlier occurrences in
+        its OWN prompt + generated history and propose the continuation
+        after the LATEST match — up to ``spec_k`` tokens, clamped so the
+        drafts plus the guaranteed bonus token never exceed the horizon.
+        Pure host-side numpy; returns None when nothing matches (or the
+        horizon leaves no room for even one draft), which is what lets a
+        draft-less iteration fall through to the plain step."""
+        k = min(self.spec_k, req.max_new_tokens - len(req.tokens) - 1)
+        if k < 1:
+            return None
+        if req.tokens:
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+        else:
+            ctx = req.prompt
+        L = len(ctx)
+        for n in range(min(self.spec_ngram, L - 1), 0, -1):
+            pat = ctx[L - n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx, n)
+            # Exclude the pattern's own (final) window: a self-match
+            # proposes nothing and would shadow a genuine earlier hit.
+            hits = np.flatnonzero((win[:-1] == pat).all(axis=1))
+            if hits.size:
+                # Latest hit with room for a FULL k-token continuation;
+                # otherwise the hit with the longest continuation (ties
+                # -> latest).  Plain ``hits[-1]`` degenerates on
+                # period-<=n loops: the latest occurrence sits at the
+                # very end of the context and proposes a 1-token draft
+                # when the history supports k.
+                room = np.minimum(L - (hits + n), k)
+                full = hits[room >= k]
+                i = int(full[-1]) if full.size else int(
+                    hits[len(hits) - 1 - np.argmax(room[::-1])])
+                cont = ctx[i + n:i + n + k]
+                if cont.size:
+                    return np.asarray(cont, np.int32)
+        return None
+
+    def _decode_spec_once(self) -> bool:
+        """One draft-and-verify iteration: ONE (num_slots, spec_k + 1)
+        verify forward per live generation scores the last token plus
+        every slot's padded drafts; each row keeps its longest agreeing
+        draft prefix plus one bonus/correction target (1 .. spec_k + 1
+        tokens) and advances its cache index by exactly the kept length.
+        Returns False — no launch, no program build — when NO slot
+        drafted this iteration; the caller falls through to the plain
+        step or the megastep.
+
+        The verify program is cached per (spec_k, temp, top_k, paged)
+        only: drafts shorter than ``spec_k`` are zero-padded and masked
+        via ``draft_lens``, so varying draft lengths never recompile.
+
+        RNG counters: the launch reserves ``spec_k + 1`` consecutive
+        counters (position j samples with ``counter + j`` — the exact
+        counters the sequential loop would burn for those tokens) and,
+        when the iteration was a single launch, REFUNDS the unconsumed
+        tail, so a single sampled stream's counter sequence is identical
+        spec on vs off (token-identical streams, the sampled-parity
+        oracle).  Multi-launch iterations skip the refund: concurrent
+        generations interleave counters either way, and every target is
+        still a fresh-key categorical draw from the correct conditional
+        (distribution-exact)."""
+        decoding = self._decode_snapshot()
+        active_slots = list(decoding)
+        if not active_slots:
+            return False
+        drafts: Dict[int, np.ndarray] = {}
+        for slot in active_slots:
+            d = self._draft_for(decoding[slot])
+            if d is not None:
+                drafts[slot] = d
+        if not drafts:
+            return False  # fall through: never build a k=0 verify
+        K = self.spec_k
+        iter_start = time.monotonic()
+        tokens_in = np.zeros((self.num_slots, K + 1), np.int32)
+        tokens_in[:, 0] = self._last_tok[:, 0]
+        draft_lens = np.zeros((self.num_slots,), np.int32)
+        for slot, d in drafts.items():
+            tokens_in[slot, 1:1 + d.size] = d
+            draft_lens[slot] = d.size
+        for slot in active_slots:
+            # Cover every position this launch may write (last token +
+            # accepted drafts), clamped to the admission reservation.
+            req = decoding[slot]
+            self._ensure_blocks(req, spec_coverage(
+                len(req.prompt), len(req.tokens),
+                int(draft_lens[slot]), req.max_new_tokens))
+        by_gen: Dict[int, List[int]] = {}
+        for slot in active_slots:
+            by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
+        launches: List[Tuple[List[int], Any, Any]] = []
+        for generation in sorted(by_gen):
+            slots = by_gen[generation]
+            active = np.zeros((self.num_slots,), bool)
+            active[slots] = True
+            targets_dev, accepted_dev, self._cache = (
+                self.engine.verify_slots(
+                    self._cache, tokens_in, active, draft_lens,
+                    temperature=self.temperature, top_k=self.top_k,
+                    counter=self._next_counter(K + 1),
+                    params=decoding[slots[0]].gen.params,
+                    **self._paged_call_kwargs()))
+            launches.append((slots, targets_dev, accepted_dev))
+        # The next iteration's input token is the per-slot LAST kept
+        # target — host-assembled from the fetch below, so the device
+        # token chain breaks here by design.
+        self._dev_last_tok = None
+        with self._lock:
+            self._iterations += 1
+            self._occupancy_sum += len(active_slots)
+            self._last_occupancy = len(active_slots)
+        fetched = [(slots, np.asarray(jax.device_get(targets_dev)),
+                    np.asarray(jax.device_get(accepted_dev)))
+                   for slots, targets_dev, accepted_dev in launches]
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "iteration", cat="serve", tid=0,
+                start=iter_start, end=time.monotonic(),
+                args={"active_slots": len(active_slots),
+                      "generations": len(by_gen), "spec_k": K,
+                      "drafted": int(draft_lens.sum())})
+        step_done = time.monotonic()
+        gaps: List[float] = []
+        emitted_per_slot: List[int] = []
+        appended = 0
+        accepted_total = 0
+        consumed = 1
+        for slots, targets, accepted in fetched:
+            for slot in slots:
+                req = decoding[slot]
+                acc = int(accepted[slot])
+                n = 0
+                for j in range(acc + 1):
+                    if req.done():
+                        break  # eos mid-acceptance trims the tail
+                    req.tokens.append(int(targets[slot, j]))
+                    n += 1
+                appended += n
+                accepted_total += min(acc, n)
+                consumed = max(consumed, n)
+                emitted_per_slot.append(n)
+                self._last_tok[slot, 0] = req.tokens[-1]
+                if n and req.last_token_at is not None:
+                    per = (step_done - req.last_token_at) * 1000.0 / n
+                    gaps.extend([per] * n)
+                req.last_token_at = step_done
+                if req.done():
+                    self._retire(req)
+        drafted_total = int(draft_lens.sum())
+        with self._lock:
+            if len(launches) == 1:
+                # Refund the counters the launch reserved but no slot's
+                # emitted token consumed: the next iteration resumes at
+                # exactly the counter the sequential loop would be at.
+                self._decode_counter -= (K + 1) - consumed
+            self._tpot_gaps_ms.extend(gaps)
+            # A verify launch IS a decode launch: the steps-per-token
+            # surface (launches vs tokens fetched) spans both paths.
+            self._megastep_launches += len(launches)
+            self._megastep_tokens += appended
+            self._spec_launches += len(launches)
+            self._spec_drafted += drafted_total
+            self._spec_accepted += accepted_total
+            self._spec_emitted += appended
+            self._obs["spec_drafted"].inc(drafted_total)
+            self._obs["spec_accepted"].inc(accepted_total)
+            if drafted_total:
+                self._obs["spec_accept_rate"].observe(
+                    accepted_total / drafted_total)
+            for n in emitted_per_slot:
+                if n:
+                    self._obs["spec_accepted_len"].observe(n)
+            saved = appended - len(launches)
+            if saved > 0:
+                self._obs["megastep_amortized"].inc(saved)
+        return True
 
     def _next_counter(self, count: int = 1) -> int:
         """Reserve ``count`` consecutive in-step RNG counters and return

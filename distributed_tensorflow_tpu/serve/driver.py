@@ -110,6 +110,22 @@ class ServeArgs:
     # greedy output is bit-identical K on vs off.  1 = classic
     # one-launch-per-token path.
     megastep: int = 1
+    # Speculative decoding: k >= 1 turns each decode iteration into
+    # draft-and-verify — an n-gram prompt-lookup drafter (no second
+    # model) proposes up to k tokens per slot from the slot's own
+    # prompt+output history, and ONE (num_slots, k+1) verify forward
+    # accepts the longest agreeing prefix + a bonus token per slot.
+    # Greedy output is bit-identical k on vs off; sampled stays
+    # distribution-exact.  0 = off.
+    spec_k: int = 0
+    # Longest history n-gram the drafter matches (it backs off to 1).
+    spec_ngram: int = 3
+    # Repetitive traffic mix: >0 builds each prompt's tail by tiling a
+    # motif of this many tokens instead of i.i.d. random tokens — the
+    # structured/repetitive workload prompt-lookup drafting wins on
+    # (tiny greedy models loop on such prompts, so drafts keep landing).
+    # 0 keeps the fully-random mix.
+    prompt_period: int = 0
     # Shared-prefix traffic mix: >0 prepends a system prompt of this many
     # tokens to every request, drawn from `shared_prefix_groups` distinct
     # prefixes — the workload prefix caching exists for.  0 keeps the
@@ -202,8 +218,17 @@ def _make_requests(args: ServeArgs, engine: ServeEngine,
                 for _ in range(max(1, args.shared_prefix_groups))]
         payloads = []
         for i in range(args.steps):
-            tail = rng.integers(0, vocab, size=(lens[i % len(lens)],),
-                                dtype=np.int32)
+            n = lens[i % len(lens)]
+            if args.prompt_period > 0:
+                # Repetitive mix: tile a per-request motif to the cycled
+                # length — the structured workload the prompt-lookup
+                # drafter exists for.
+                motif = rng.integers(
+                    0, vocab, size=(min(args.prompt_period, n),),
+                    dtype=np.int32)
+                tail = np.tile(motif, -(-n // motif.size))[:n]
+            else:
+                tail = rng.integers(0, vocab, size=(n,), dtype=np.int32)
             prompt = (tail if prefixes is None
                       else np.concatenate([prefixes[i % len(prefixes)],
                                            tail]))
@@ -280,6 +305,8 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            spec_k=args.spec_k or None,
+            spec_ngram=args.spec_ngram,
             **_cache_kwargs(args),
         )
         return DynamicBatcher(iteration_level=True, scheduler=scheduler)
@@ -336,6 +363,8 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            spec_k=args.spec_k or None,
+            spec_ngram=args.spec_ngram,
             name=f"serve-fleet-r{i}",
             **_cache_kwargs(args),
         )
@@ -380,6 +409,8 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
             temperature=args.temperature, top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            spec_k=args.spec_k or None,
+            spec_ngram=args.spec_ngram,
             **warm_kwargs)
         lengths = sorted({p.shape[0] for p, _ in payloads})
         warm_lengths = set(lengths)
@@ -535,6 +566,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["megastep"] = int(args.megastep)
         out["megastep_launches"] = int(stats.get("megastep_launches", 0.0))
         out["megastep_tokens"] = int(stats.get("megastep_tokens", 0.0))
+        out["spec_k"] = int(args.spec_k)
+        if args.spec_k:
+            out["spec_launches"] = int(stats.get("spec_launches", 0.0))
+            out["spec_drafted"] = int(stats.get("spec_drafted", 0.0))
+            out["spec_accepted"] = int(stats.get("spec_accepted", 0.0))
+            out["spec_emitted"] = int(stats.get("spec_emitted", 0.0))
+            out["spec_acceptance_rate"] = round(
+                stats.get("spec_acceptance_rate", 0.0), 4)
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
         if args.cache_mode == "paged":

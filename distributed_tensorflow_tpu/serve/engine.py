@@ -77,6 +77,10 @@ def _engine_instruments(registry=None):
         "megastep": r.histogram(
             "dtt_serve_megastep_seconds",
             "Host-side megastep dispatch duration (K fused decode steps)"),
+        "verify": r.histogram(
+            "dtt_serve_verify_seconds",
+            "Host-side speculative-verify dispatch duration "
+            "(one (num_slots, k+1) forward)"),
     }
 
 
@@ -604,25 +608,33 @@ class ServeEngine:
     def _megastep_apply(self, steps, temperature, top_k, paged, params,
                         cache, tokens, active, horizon, eos_rows,
                         block_tables, rng, counter):
-        """K fused decode iterations as ONE program: ``lax.scan`` over the
-        inner step with the whole per-slot decode state in the carry.
+        """K fused decode iterations as ONE program: a bounded
+        ``lax.while_loop`` over the inner step with the whole per-slot
+        decode state in the carry, exiting EARLY once every row is dead
+        instead of riding out the remaining masked no-op steps.
 
-        Carry: (cache, last token (num_slots,), alive mask, remaining
-        horizon).  A row is alive while it is ``active``, has horizon
-        left, and has not emitted its eos; a dead row's token stops
-        advancing (``jnp.where`` keeps the old one) and its
-        ``cache_index``/``position`` rows are gated exactly like the
-        single-step path, so a row finishing at inner step j < K is
-        byte-identical to having stopped the loop there.  Sampling folds
-        ``counter + j`` into the base key per inner step — the SAME
-        per-token keys the K=1 loop would burn, so sampled output is
-        reproducible across megastep sizes too.
+        Carry: (step index, cache, last token (num_slots,), alive mask,
+        remaining horizon, (num_slots, K) token buffer).  A row is alive
+        while it is ``active``, has horizon left, and has not emitted its
+        eos; a dead row's token stops advancing (``jnp.where`` keeps the
+        old one) and its ``cache_index``/``position`` rows are gated
+        exactly like the single-step path, so a row finishing at inner
+        step j < K is byte-identical to having stopped the loop there.
+        Steps past the all-dead exit never execute — their buffer
+        columns stay at init, which is safe because the host's
+        ``req.done()`` trim walk never reads a column past the step its
+        row died at.  Sampling folds ``counter + j`` into the base key
+        per EXECUTED inner step — the SAME per-token keys the K=1 loop
+        would burn, so sampled output is reproducible across megastep
+        sizes too.  The executed-step count rides out as a device
+        scalar (``steps_run``) so the scheduler can account the saved
+        iterations.
         """
         num_slots = tokens.shape[0]
         slots = jnp.arange(num_slots, dtype=jnp.int32)
 
-        def _inner(carry, j):
-            cache, tok, alive, left = carry
+        def _body(state):
+            j, cache, tok, alive, left, toks = state
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 decode=True, slot_ids=slots, mutable=["cache"],
@@ -645,32 +657,41 @@ class ServeEngine:
             hit_eos = (eos_rows >= 0) & (tok_next == eos_rows)
             left_next = jnp.where(alive, left - 1, left)
             alive_next = alive & ~hit_eos & (left_next > 0)
-            return (gated, tok_next, alive_next, left_next), tok_next
+            toks = jax.lax.dynamic_update_slice(
+                toks, tok_next[:, None], (jnp.int32(0), j))
+            return (j + 1, gated, tok_next, alive_next, left_next, toks)
 
-        init = (cache, tokens,
-                active & (horizon > 0), horizon)
-        (cache, tok_final, _, _), toks = jax.lax.scan(
-            _inner, init, jnp.arange(steps, dtype=jnp.uint32))
-        # (K, num_slots) -> (num_slots, K): one fetch per megastep.
-        return jnp.swapaxes(toks, 0, 1), tok_final, cache
+        def _cond(state):
+            j, _, _, alive, _, _ = state
+            return (j < steps) & jnp.any(alive)
+
+        init = (jnp.int32(0), cache, tokens, active & (horizon > 0),
+                horizon, jnp.zeros((num_slots, steps), jnp.int32))
+        steps_run, cache, tok_final, _, _, toks = jax.lax.while_loop(
+            _cond, _body, init)
+        return toks, tok_final, steps_run, cache
 
     def decode_megastep(self, cache: PyTree, last_tokens, active: np.ndarray,
                         horizon: np.ndarray, *, steps: int,
                         eos_rows=None, temperature: float = 0.0,
                         top_k: int = 0, rng=None, counter: int = 0,
                         paged=None, block_tables=None, params=None):
-        """K decode iterations in ONE compiled program (``lax.scan`` over
-        the step).  Returns (tokens (num_slots, K), final token
-        (num_slots,), updated cache); the cache is donated through the
-        call.
+        """K decode iterations in ONE compiled program (a bounded
+        ``lax.while_loop`` over the step).  Returns (tokens
+        (num_slots, K), final token (num_slots,), executed inner steps
+        (device scalar), updated cache); the cache is donated through
+        the call.
 
         ``horizon`` (num_slots,) int32 is each slot's remaining token
         budget; a row stops advancing once it runs out or emits its eos
         (``eos_rows`` (num_slots,) int32, -1 = no eos for that row), and
-        the host trims the tail columns of its output row.  The final
-        token is taken from the GATED carry, so it is each row's true
-        last live token — valid to chain into the next megastep for every
-        row, including those that died mid-scan.
+        the host trims the tail columns of its output row.  Once EVERY
+        row is dead the loop exits early — the executed-step scalar is
+        then < K and the untouched tail columns are never read by the
+        host trim.  The final token is taken from the GATED carry, so it
+        is each row's true last live token — valid to chain into the
+        next megastep for every row, including those that died
+        mid-loop.
 
         Paged mode requires the caller to have precomputed block-table
         coverage for all K positions up front (reservation-at-admit
@@ -712,6 +733,115 @@ class ServeEngine:
                 tokens_dev, np.asarray(active, bool),
                 np.asarray(horizon, np.int32), eos, bt, base, counter)
         self._obs["megastep"].observe(time.perf_counter() - t0)
+        return out
+
+    def _verify_slots_apply(self, k, temperature, top_k, paged, params,
+                            cache, tokens, active, draft_lens,
+                            block_tables, rng, counter):
+        """Speculative verify as ONE program: a (num_slots, k+1) forward
+        whose input row is [last token, draft_0 .. draft_{k-1}].
+
+        Position j's logits predict the token AFTER input column j, so
+        the per-position target token is selected with the SAME
+        ``fold_in`` counter (``counter + j``) the sequential loop would
+        burn for that token — which is what makes the emitted stream
+        identical to sequential decoding: greedy targets are the exact
+        greedy tokens (bit-parity), and sampled targets are the exact
+        samples the per-token launches would have drawn, draft agreement
+        only deciding how MANY of them this launch gets to keep (the
+        point-mass-draft reduction of speculative rejection sampling, so
+        sampled output stays distribution-exact).
+
+        A draft token is accepted while every earlier draft matched its
+        target (``cumprod`` of the per-position agreement, masked past
+        each row's real ``draft_lens``); the emitted row is its accepted
+        prefix plus one bonus/correction target.  ``cache_index`` /
+        ``position`` advance by accepted+1 per ACTIVE row — computed
+        from the pre-apply values, rolling back the k+1-token advance
+        the forward performed; the rejected drafts' K/V stays behind the
+        rolled-back index where the causal mask (dense) or the slot's
+        own blocks (paged) never expose it."""
+        num_slots = tokens.shape[0]
+        slots = jnp.arange(num_slots, dtype=jnp.int32)
+        logits, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens,
+            decode=True, slot_ids=slots, mutable=["cache"],
+            **self._paged_kwargs(paged, block_tables),
+        )
+        targets = jnp.stack(
+            [_select_next(logits[:, j, :], rng, counter + j,
+                          temperature, top_k) for j in range(k + 1)],
+            axis=1)
+        drafts = tokens[:, 1:]
+        pos = jnp.arange(k, dtype=jnp.int32)[None, :]
+        match = (drafts == targets[:, :k]) & (pos < draft_lens[:, None])
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        accepted = jnp.where(active, accepted, 0)
+        advance = jnp.where(active, accepted + 1, 0)
+
+        def _gate(path, new, old):
+            name = (path[-1].key if hasattr(path[-1], "key")
+                    else str(path[-1]))
+            if name in ("cache_index", "position"):
+                adv = advance.astype(old.dtype)
+                return old + (adv if new.ndim == 1 else adv[None, :])
+            return new
+
+        gated = jax.tree_util.tree_map_with_path(
+            _gate, mutated["cache"], cache)
+        return targets, accepted, gated
+
+    def verify_slots(self, cache: PyTree, tokens: np.ndarray,
+                     active: np.ndarray, draft_lens: np.ndarray, *,
+                     temperature: float = 0.0, top_k: int = 0,
+                     rng=None, counter: int = 0,
+                     paged=None, block_tables=None, params=None):
+        """One speculative-decoding verify step over ALL slots.
+
+        ``tokens`` is (num_slots, k+1) int32: column 0 is each slot's
+        last emitted token, columns 1..k its draft tokens padded past
+        ``draft_lens`` (pad values never accepted — the per-slot length
+        mask bounds the agreement prefix).  Returns (targets
+        (num_slots, k+1), accepted draft count (num_slots,), updated
+        cache); row i's emitted tokens are ``targets[i, :accepted[i]+1]``
+        — at least one token per active row, so a launch never stalls a
+        stream.  The cache is donated through the call.
+
+        The program is cached per (k, temperature, top_k, paged) and
+        launched under the process launch lock like every other slot
+        program; ``params`` overrides for hot reload without recompiles.
+        Paged mode needs block coverage for all k+1 written positions up
+        front (``PagedKVConfig.blocks_for_spec``) — rejected drafts'
+        writes land in the slot's own blocks behind its rolled-back
+        index, inactive rows' in the trash block."""
+        if (paged is None) != (block_tables is None):
+            raise ValueError("paged and block_tables go together")
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 2 or tokens.shape[1] < 2:
+            raise ValueError(
+                f"verify tokens must be (num_slots, k+1) with k >= 1, "
+                f"got {tokens.shape} — a k=0 verify is just the plain "
+                f"decode step; route it there instead")
+        k = tokens.shape[1] - 1
+        key = ("slot_verify", k, float(temperature), int(top_k), paged)
+        base = rng if rng is not None else self._sample_rng
+        bt = block_tables
+        if bt is not None and not isinstance(bt, jax.Array):
+            bt = np.asarray(bt, np.int32)
+        t0 = time.perf_counter()
+        with _launch_lock:
+            if key not in self._generate_fns:
+                self._obs["compiles"].labels(kind="slot_verify").inc()
+                self._generate_fns[key] = jax.jit(
+                    functools.partial(self._verify_slots_apply, k,
+                                      float(temperature), int(top_k), paged),
+                    donate_argnums=(1,))
+            tokens_dev = jax.device_put(tokens, batch_sharding(self.mesh))
+            out = self._generate_fns[key](
+                self.params if params is None else params, cache,
+                tokens_dev, np.asarray(active, bool),
+                np.asarray(draft_lens, np.int32), bt, base, counter)
+        self._obs["verify"].observe(time.perf_counter() - t0)
         return out
 
     def generate(self, prompts: np.ndarray, max_new_tokens: int, *,

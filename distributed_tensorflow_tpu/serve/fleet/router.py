@@ -71,6 +71,14 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     the count shows.  The scale saturates at 2x so one huge K cannot
     drown the occupancy/KV signals; homogeneous fleets (every replica
     the same K) keep identical rankings, megastep or not.
+
+    Speculative decoding DISCOUNTS the queue-depth term: a replica whose
+    verify launches are accepting drafts emits more than one token per
+    launch, so its queued work drains faster than its depth suggests —
+    the discount tracks the realized acceptance rate (down to 0.5x at
+    full acceptance, none at zero), so an idle-drafter replica ranks
+    exactly like a spec-off one and homogeneous fleets keep identical
+    rankings.
     """
     depth = stats.get("queue_depth", 0.0)
     cap = max(1.0, stats.get("capacity", 1.0))
@@ -82,7 +90,11 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     kv_pressure = (1.0 - free / total) if total else 0.0
     mega = max(1.0, stats.get("megastep", 1.0))
     boundary_scale = min(2.0, 1.0 + (mega - 1.0) / 8.0)
-    return (4.0 * depth / cap * boundary_scale
+    spec_scale = 1.0
+    if stats.get("spec_k", 0.0):
+        accept = min(1.0, max(0.0, stats.get("spec_acceptance_rate", 0.0)))
+        spec_scale = 1.0 / (1.0 + accept)
+    return (4.0 * depth / cap * boundary_scale * spec_scale
             + 2.0 * (active + prefilling) / slots
             + kv_pressure)
 
@@ -232,14 +244,15 @@ class FleetRouter:
         "iterations", "kv_hbm_bytes", "blocks_total", "blocks_free",
         "blocks_in_use", "blocks_high_water", "last_occupancy",
         "prefilling_slots", "prefill_backlog_tokens", "prefill_chunks",
-        "megastep_launches", "megastep_tokens",
+        "megastep_launches", "megastep_tokens", "megastep_effective_steps",
+        "spec_launches", "spec_drafted", "spec_accepted", "spec_emitted",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
         "tpot_mean_ms", "tpot_p50_ms", "tpot_p99_ms",
         "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
-        "param_generation", "prefill_budget", "megastep",
+        "param_generation", "prefill_budget", "megastep", "spec_k",
     )
 
     def stats(self) -> Dict[str, float]:
@@ -261,6 +274,12 @@ class FleetRouter:
         out["block_utilization"] = (
             out["blocks_in_use"] / out["blocks_total"]
             if out["blocks_total"] else 0.0)
+        out["spec_acceptance_rate"] = (
+            out["spec_accepted"] / out["spec_drafted"]
+            if out["spec_drafted"] else 0.0)
+        out["spec_tokens_per_launch"] = (
+            out["spec_emitted"] / out["spec_launches"]
+            if out["spec_launches"] else 0.0)
         with self._lock:
             out["replicas"] = float(len(self.replicas))
             out["shed"] = float(self._shed)

@@ -144,6 +144,21 @@ def megastep_coverage(prompt_len: int, generated: int, steps: int,
                prompt_len + max_new_tokens - 1)
 
 
+def spec_coverage(prompt_len: int, generated: int, draft_len: int,
+                  max_new_tokens: int) -> int:
+    """K/V positions a speculative verify launch's block tables must
+    cover: the (1 + ``draft_len``)-token forward writes positions
+    ``prompt_len + generated - 1 .. + draft_len``, which is exactly a
+    megastep of ``draft_len + 1`` inner steps — including the clamp to
+    the admission reservation (a row never allocates past what admission
+    promised; drafts the horizon cannot hold are rejected or trimmed and
+    their garbage writes land behind the rolled-back index)."""
+    if draft_len < 0:
+        raise ValueError(f"draft_len must be >= 0, got {draft_len}")
+    return megastep_coverage(prompt_len, generated, draft_len + 1,
+                             max_new_tokens)
+
+
 class BlockExhaustedError(RuntimeError):
     """Raised when an allocation is requested that the pool cannot satisfy.
 

@@ -21,6 +21,8 @@ Examples:
         --prompt_lens=8,8,8,512       # chunked prefill under whale prompts
     python serve.py --model=gpt2 --continuous --megastep=8 \
         --max_new_tokens=32           # K fused decode steps per dispatch
+    python serve.py --model=gpt2 --continuous --spec_k=4 \
+        --prompt_period=4             # speculative decode, repetitive mix
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
@@ -128,6 +130,22 @@ def parse_args(argv=None):
                         "finishing mid-megastep stop on device and trim on "
                         "host, so greedy output is bit-identical to "
                         "--megastep=1 (the classic per-token launch)")
+    p.add_argument("--spec_k", type=int, default=defaults.spec_k,
+                   help="continuous mode: speculative decoding — an "
+                        "n-gram prompt-lookup drafter (no second model) "
+                        "proposes up to k tokens per slot from the "
+                        "slot's own history, verified in ONE "
+                        "(num_slots, k+1) forward; greedy output is "
+                        "bit-identical k on vs off (0 = off)")
+    p.add_argument("--spec_ngram", type=int, default=defaults.spec_ngram,
+                   help="speculative decoding: longest history n-gram "
+                        "the drafter matches (backs off to 1)")
+    p.add_argument("--prompt_period", type=int,
+                   default=defaults.prompt_period,
+                   help="traffic mix: tile each prompt from a motif of "
+                        "this many tokens instead of i.i.d. random — "
+                        "the repetitive workload prompt-lookup drafting "
+                        "wins on (0 = fully random)")
     p.add_argument("--shared_prefix_len", type=int,
                    default=defaults.shared_prefix_len,
                    help="traffic mix: prepend a shared system prompt of "

@@ -1,0 +1,32 @@
+"""Seeded unlocked speculative-verify launch: the (num_slots, k+1)
+verify program (cached in a program dict keyed on k) dispatched from the
+scheduler's worker thread with no module-level launch lock.  Two
+replicas verifying concurrently deadlock in the XLA collective
+rendezvous exactly like single-step decode — the verify forward runs
+the full layer stack's collectives for k+1 positions at once.
+``collective-launch`` must flag the dispatch site."""
+
+import threading
+
+import jax
+
+
+class MiniEngine:
+    def __init__(self):
+        self._programs = {}
+        self._programs["slot_verify"] = jax.jit(lambda toks: toks)
+
+    def verify_slots(self, toks):
+        return self._programs["slot_verify"](toks)  # SEED: verify launch without a launch lock
+
+
+class Scheduler:
+    def __init__(self, engine: "MiniEngine"):
+        self.engine: "MiniEngine" = engine
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        self.engine.verify_slots(None)

@@ -56,6 +56,7 @@ class TestSeededFixtures:
         ("race", CrossThreadRaceRule, "cross-thread-race"),
         ("launch", CollectiveLaunchRule, "collective-launch"),
         ("megastep", CollectiveLaunchRule, "collective-launch"),
+        ("spec", CollectiveLaunchRule, "collective-launch"),
     ]
 
     @pytest.mark.parametrize("stem,rule_cls,rule_id",

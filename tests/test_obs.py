@@ -312,6 +312,25 @@ class TestHookLogCompat:
             "occupancy=0.50 adm/it=0.30 ret/it=0.30 ttft_p50=20.0ms "
             "ttft_p99=50.0ms tpot=1.50ms p50=30.0ms p99=80.0ms")
 
+    def test_spec_line_pinned(self, caplog):
+        """A spec-enabled scheduler gets its OWN pinned line after the
+        continuous one; spec-off stats (no spec_k key, or spec_k=0) must
+        not emit it — the continuous line above stays byte-identical."""
+        stats = dict(CONTINUOUS_STATS, spec_k=4, spec_drafted=40,
+                     spec_accepted=25, spec_acceptance_rate=0.625,
+                     spec_launches=12, spec_emitted=37,
+                     spec_tokens_per_launch=37 / 12)
+        assert self._log_line(caplog, stats) == (
+            "serve @ 100: spec k=4 drafted=40 accepted=25 "
+            "accept_rate=0.62 launches=12 emitted=37 tok/launch=3.08")
+        spec_lines = [rec.getMessage() for rec in caplog.records
+                      if "spec k=" in rec.getMessage()]
+        assert len(spec_lines) == 1
+        caplog.clear()
+        self._log_line(caplog, dict(CONTINUOUS_STATS, spec_k=0))
+        assert not any("spec k=" in rec.getMessage()
+                       for rec in caplog.records)
+
     def test_prefetch_line_unchanged(self, caplog):
         from distributed_tensorflow_tpu.obs import prefetch as obs_prefetch
 

@@ -66,6 +66,7 @@ class TestCtorValidation:
         assert stats["megastep"] == 8.0
         assert stats["megastep_launches"] == 0.0
         assert stats["megastep_tokens"] == 0.0
+        assert stats["megastep_effective_steps"] == 0.0
         sched.close(timeout=0.1)
 
 
@@ -145,8 +146,15 @@ class TestMegastepEos:
                     # Every decode-appended token was counted (the first
                     # generated token comes from prefill); a post-EOS
                     # leak would show up as extra megastep_tokens.
-                    assert sched.stats()["megastep_tokens"] == len(
+                    stats = sched.stats()
+                    assert stats["megastep_tokens"] == len(
                         outs[steps]) - 1
+                    # Early exit: EOS at inner step j < K stops the
+                    # while_loop once every row is dead — strictly fewer
+                    # effective inner steps than launches * K, instead
+                    # of riding out the masked no-op tail.
+                    assert 0 < stats["megastep_effective_steps"] \
+                        < stats["megastep_launches"] * steps
         np.testing.assert_array_equal(outs[8], outs[1])
         assert len(outs[8]) == eos_idx + 1 < horizon  # stopped mid-scan
         assert outs[8][-1] == eos

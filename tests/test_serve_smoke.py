@@ -139,6 +139,24 @@ def test_serve_entrypoint_megastep_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_spec_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous", "--spec_k=4", "--prompt_period=4",
+                "--num_slots=8", "--steps=12", "--prompt_lens=8,12",
+                "--max_new_tokens=8", "--min_new_tokens=4"])
+    assert out["scheduler"] == "continuous"
+    assert out["completed"] == 12
+    assert out["spec_k"] == 4
+    # The repetitive (motif-tiled) mix makes drafts land: accepted
+    # tokens and launch amortization both show up in the counters.
+    assert out["spec_launches"] > 0
+    assert out["spec_acceptance_rate"] > 0
+    assert 0 < out["megastep_launches"] < out["megastep_tokens"]
+    assert len(out["tokens_checksum"]) == 16
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
@@ -198,3 +216,20 @@ def test_bench_serve_mode_prints_one_json_line():
     assert out["megastep_parity"] is True
     assert out["megastep_speedup"] >= 1.0
     assert out["megastep_launches"] < out["megastep_base_launches"]
+    # the speculative-decoding claim: on the repetitive mix the drafter
+    # lands, the verifier emits more than one token per launch
+    # (steps-per-token speedup > 1), and greedy output stays
+    # bit-identical spec on vs off — alone and composed with chunked
+    # prefill, the megastep, and the prefix cache
+    for key in ("spec_k", "spec_steps_per_token",
+                "spec_base_steps_per_token", "spec_launches",
+                "spec_drafted", "spec_accepted"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["spec_k"] == 4
+    assert out["spec_parity"] is True
+    assert out["spec_acceptance_rate"] > 0
+    assert out["spec_speedup"] >= 1.0
+    assert out["spec_steps_per_token"] < out["spec_base_steps_per_token"]
+    assert out["spec_chunked_parity"] is True
+    assert out["spec_megastep_parity"] is True
+    assert out["spec_prefix_parity"] is True

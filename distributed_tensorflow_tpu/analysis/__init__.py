@@ -1,7 +1,7 @@
 """dttlint: project-specific static analysis for this codebase.
 
-``python -m distributed_tensorflow_tpu.analysis`` runs six rules over
-the tree and exits non-zero on any non-baselined finding:
+``python -m distributed_tensorflow_tpu.analysis`` runs the full rule set
+over the tree and exits non-zero on any non-baselined finding:
 
 - ``jit-purity`` — no host side effects (time/random/logging/print/obs)
   reachable from ``jax.jit``-compiled functions;
@@ -9,6 +9,13 @@ the tree and exits non-zero on any non-baselined finding:
   and hashable; compiled closures must not capture mutable locals;
 - ``lock-discipline`` — attributes written under ``self._lock`` are
   flagged wherever they're touched outside it;
+- ``lock-order`` / ``cross-thread-race`` / ``collective-launch`` — the
+  whole-program concurrency triple over the shared call-graph facts;
+- ``use-after-donate`` / ``host-sync`` / ``donation-discipline`` — the
+  device-boundary triple: donated buffers die at launch and must be
+  rebound, device values must not be implicitly fetched inside hot
+  loops (``jax.device_get`` marks the sanctioned explicit fetch), and
+  mutated-and-returned jit parameters must be donated;
 - ``layering`` — obs core imports no jax/flax, models/training/data
   import no serve, no top-level import cycles;
 - ``unused-import`` / ``mutable-default`` — the hygiene pair ruff

@@ -83,6 +83,7 @@ def main(argv: List[str] | None = None) -> int:
         description="project-specific static analysis "
                     "(jit-purity, recompile-hazard, lock-discipline, "
                     "lock-order, cross-thread-race, collective-launch, "
+                    "use-after-donate, host-sync, donation-discipline, "
                     "layering, hygiene)")
     parser.add_argument("paths", nargs="*", type=Path,
                         help="files/dirs to analyze (default: whole tree)")

@@ -253,7 +253,8 @@ class Rule:
 
 # -- engine -------------------------------------------------------------------
 
-DEFAULT_EXCLUDE_DIRS = {"tests", "examples", "__pycache__", ".git"}
+DEFAULT_EXCLUDE_DIRS = {"tests", "examples", "__pycache__", ".git",
+                        ".pytest_cache"}
 
 
 def collect_files(paths: Iterable[Path], repo_root: Path) -> List[Path]:

@@ -1,0 +1,1136 @@
+"""Device-boundary dataflow rules: use-after-donate, host-sync,
+donation-discipline.
+
+All three consume one :class:`DeviceFacts` instance layered on the shared
+:class:`~.core.ConcurrencyFacts` (call graph, class index, thread roots).
+The facts add what the concurrency layer deliberately ignored — *buffers*:
+
+- a **jit-boundary graph**: every ``jax.jit`` site with its literal
+  ``donate_argnums`` / ``static_argnums``, the callable it wraps (resolved
+  through ``functools.partial``, ``self._attr`` methods and local defs),
+  and where the compiled callable flows (local name, ``self._attr``,
+  ``self._fns[key]`` dict attr, returned, passed as an argument) — a
+  whole-program fixpoint, so ``build_state_and_step``'s jitted train step
+  is still known to donate position 0 by the time ``TrainLoop.run_one_step``
+  launches it via ``self.train_step``;
+- a **device-value taint**: results of compiled launches (and of functions
+  that return them), ``jax.device_put``, and any attribute ever assigned
+  such a value, propagated through assignments with a conservative
+  may-alias treatment of tuple unpacking (every target of
+  ``a, b = launch(...)`` is tainted);
+- **hot loops** from the call graph, not a name allowlist: a ``for``/
+  ``while`` whose body (transitively) launches a compiled program, plus
+  every unit reachable from inside such a body.
+
+Rules:
+
+- **use-after-donate** — a name (or ``self._attr``) passed in a donated
+  position of a launch is dead afterwards; reading it again without
+  rebinding it to the call's result is the exact hazard the engine's
+  donated-cache chaining documents by hand (``tok, cache = step(params,
+  cache, ...)`` — the rebinding IS the discipline).  May-analysis:
+  branches union their dead sets, loop bodies run twice so a
+  donate-at-the-bottom poisons the read at the top.
+- **host-sync** — a device-tainted value flowing into ``float()`` /
+  ``int()`` / ``bool()`` / ``.item()`` / ``.tolist()`` / ``np.asarray`` /
+  ``block_until_ready`` inside hot code stalls the dispatch pipeline once
+  per iteration.  ``jax.device_get`` deliberately LAUNDERS taint instead
+  of sinking: it is this repo's sanctioned idiom for the one visible,
+  batched fetch an iteration is allowed (``bool(jax.device_get(done)...)``
+  gated to every ``check_every`` steps), so the rule flags the accidental
+  implicit syncs while leaving the explicit fetch points alone.
+- **donation-discipline** — a jitted program whose wrapped function
+  mutates-and-returns a parameter-shaped pytree (feeds it to a
+  ``mutable=[...]``-listed key of a flax ``.apply`` variables dict, or
+  returns the parameter outright) without donating that argument keeps
+  BOTH the input and output buffers live: the double-HBM footgun for
+  every future decode variant.  Sites with non-literal ``donate_argnums``
+  or an unresolvable wrapped callable are skipped, never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import (
+    Dict, FrozenSet, List, Optional, Sequence, Set, Tuple,
+)
+
+from distributed_tensorflow_tpu.analysis.concurrency import shared_facts
+from distributed_tensorflow_tpu.analysis.core import (
+    JIT_FACTORIES,
+    ConcurrencyFacts,
+    Finding,
+    FnKey,
+    Module,
+    Rule,
+    UnitFacts,
+    dotted,
+    self_attr,
+)
+
+UAD_RULE_ID = "use-after-donate"
+SYNC_RULE_ID = "host-sync"
+DONATE_RULE_ID = "donation-discipline"
+
+#: Donation info for a jit-valued expression: a frozenset of donated
+#: argument indices when the site was literal, or UNKNOWN when the value
+#: is known-jitted but its donation could not be parsed (non-literal
+#: donate_argnums, wrapper heuristics).  ``None`` everywhere below means
+#: "not a jit value at all".
+UNKNOWN = frozenset({-1})
+
+_PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+_DEVICE_GET = frozenset({"jax.device_get"})
+_DEVICE_PUT = frozenset({"jax.device_put", "jax.device_put_replicated"})
+_NP_SINKS = frozenset({"numpy.asarray", "numpy.array", "np.asarray",
+                       "np.array"})
+_METHOD_SINKS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+def _merge(a: Optional[FrozenSet[int]], b: Optional[FrozenSet[int]]
+           ) -> Optional[FrozenSet[int]]:
+    """Join of two donation values (None = not-jit)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a == UNKNOWN or b == UNKNOWN:
+        return UNKNOWN
+    return a | b
+
+
+def _literal_argnums(kw: Optional[ast.AST]) -> Optional[FrozenSet[int]]:
+    """Parse a literal donate_argnums/static_argnums value; UNKNOWN if
+    the keyword is present but not a literal int / tuple of ints."""
+    if kw is None:
+        return frozenset()
+    if isinstance(kw, ast.Constant) and isinstance(kw.value, int) \
+            and not isinstance(kw.value, bool):
+        return frozenset({kw.value})
+    if isinstance(kw, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in kw.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.add(e.value)
+            else:
+                return UNKNOWN
+        return frozenset(out)
+    return UNKNOWN
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    findings = sorted(findings, key=Finding.sort_key)
+    out: List[Finding] = []
+    for f in findings:
+        if not out or out[-1].sort_key() != f.sort_key():
+            out.append(f)
+    return out
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit(...)`` call site in the jit-boundary graph."""
+
+    module: Module
+    line: int
+    donate: FrozenSet[int]  # may be UNKNOWN
+    static: FrozenSet[int]  # may be UNKNOWN
+    wrapped: Optional[FnKey]  # resolved wrapped callable, if any
+    bound: int  # positional args pre-bound by functools.partial
+    is_method: bool  # wrapped callable is a bound method (self consumed)
+
+
+class DeviceFacts:
+    """Device-boundary facts over one analyzed module set."""
+
+    def __init__(self, facts: ConcurrencyFacts):
+        self.facts = facts
+        self.jit_sites: List[JitSite] = []
+        # (class qual, attr) -> donation of the jit value stored there.
+        self.attr_jit: Dict[Tuple[str, str], FrozenSet[int]] = {}
+        self.dict_attr_jit: Dict[Tuple[str, str], FrozenSet[int]] = {}
+        # fn -> {return tuple position (-1 = whole) -> donation}.
+        self.fn_returns: Dict[FnKey, Dict[int, FrozenSet[int]]] = {}
+        # fn -> {def-order param index (self included) -> donation}.
+        self.param_jit: Dict[FnKey, Dict[int, FrozenSet[int]]] = {}
+        # fn -> caller-visible positional indices it donates onward.
+        self.fn_donates: Dict[FnKey, Set[int]] = {}
+        self.fn_returns_device: Set[FnKey] = set()
+        # (class qual, attr) ever assigned a device-tainted value.
+        self.attr_device: Set[Tuple[str, str]] = set()
+        self.launch_units: Set[FnKey] = set()
+        self.hot_units: Set[FnKey] = set()
+        # unit -> ids of its For/While nodes whose bodies launch.
+        self.hot_loops: Dict[FnKey, Set[int]] = {}
+        self.uad_findings: List[Finding] = []
+        self.sync_findings: List[Finding] = []
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        for _round in range(10):
+            self._changed = False
+            for unit in self.facts.units.values():
+                _DeviceScan(self, unit).run()
+            if not self._changed:
+                break
+        self._module_level_sites()
+        self._compute_hot()
+        for unit in self.facts.units.values():
+            _DeviceScan(self, unit, report=True).run()
+        self.uad_findings = _dedup(self.uad_findings)
+        self.sync_findings = _dedup(self.sync_findings)
+
+    def _module_level_sites(self) -> None:
+        """jit sites in module-level assigns (``STEP = jax.jit(fn)``) —
+        everything inside a unit was collected during the scans."""
+        for m in self.facts.modules:
+            for stmt in m.tree.body:
+                if isinstance(stmt, (ast.Assign, ast.Expr)):
+                    val = stmt.value
+                    if isinstance(val, ast.Call):
+                        self._maybe_module_site(m, val)
+
+    def _maybe_module_site(self, m: Module, call: ast.Call) -> None:
+        callee = dotted(call.func)
+        canon = self.facts._imports[m.name].canonical(callee) \
+            if callee else None
+        if not (callee in JIT_FACTORIES or canon in JIT_FACTORIES):
+            return
+        if any(s.module is m and s.line == call.lineno
+               for s in self.jit_sites):
+            return
+        donate = static = frozenset()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _literal_argnums(kw.value)
+            elif kw.arg == "static_argnums":
+                static = _literal_argnums(kw.value)
+        wrapped, bound, is_method = _resolve_wrapped(
+            self.facts, m, None, call.args[0] if call.args else None, {})
+        self.jit_sites.append(JitSite(
+            module=m, line=call.lineno, donate=donate, static=static,
+            wrapped=wrapped, bound=bound, is_method=is_method))
+
+    def _compute_hot(self) -> None:
+        """Launch-unit fixpoint -> hot loops -> hot-unit closure."""
+        units = self.facts.units
+        self.launch_units |= {k for k, u in units.items() if u.launches}
+        for _round in range(len(units) + 2):
+            changed = False
+            for k, u in units.items():
+                if k in self.launch_units:
+                    continue
+                if any(c in self.launch_units for (c, _h, _l) in u.calls):
+                    self.launch_units.add(k)
+                    changed = True
+            if not changed:
+                break
+        # Hot loops: a loop whose body contains a launch or a call into a
+        # launching unit.  Seed hot units from calls made inside them.
+        seeds: Set[FnKey] = set()
+        for k, u in units.items():
+            loops: Set[int] = set()
+            for node in ast.walk(u.node):
+                if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                    continue
+                body_calls = [n for stmt in node.body
+                              for n in ast.walk(stmt)
+                              if isinstance(n, ast.Call)]
+                lines = {c.lineno for c in body_calls}
+                launches_here = any(ln in lines
+                                    for (ln, _d, _h) in u.launches)
+                calls_launcher = any(
+                    ln in lines and callee in self.launch_units
+                    for (callee, _h, ln) in u.calls)
+                if launches_here or calls_launcher \
+                        or self._has_indirect_launch(u, body_calls):
+                    loops.add(id(node))
+                    for (callee, _h, ln) in u.calls:
+                        if ln in lines and callee in units:
+                            seeds.add(callee)
+            if loops:
+                self.hot_loops[k] = loops
+        # Closure: anything called from hot code is hot in its entirety.
+        self.hot_units = set(seeds)
+        for _round in range(len(units) + 2):
+            changed = False
+            for k in list(self.hot_units):
+                u = units.get(k)
+                if u is None:
+                    continue
+                for (callee, _h, _l) in u.calls:
+                    if callee in units and callee not in self.hot_units:
+                        self.hot_units.add(callee)
+                        changed = True
+            if not changed:
+                break
+
+    def _has_indirect_launch(self, unit: UnitFacts,
+                             body_calls: List[ast.Call]) -> bool:
+        """A call of a jit-valued *expression* inside the loop body (a
+        param-bound train step: ``fn(self.state, ...)``) that the
+        concurrency scanner had no reason to record as a launch."""
+        probe = _DeviceScan(self, unit)
+        probe.seed_params()
+        for c in body_calls:
+            if probe.jit_of(c.func) is not None:
+                return True
+        return False
+
+    # -- merge helpers (record global changes for the fixpoint) --------------
+
+    def merge_attr_jit(self, key: Tuple[str, str],
+                       val: FrozenSet[int], dict_attr: bool) -> None:
+        store = self.dict_attr_jit if dict_attr else self.attr_jit
+        new = _merge(store.get(key), val)
+        if new != store.get(key):
+            store[key] = new
+            self._changed = True
+
+    def merge_return(self, fn: FnKey, pos: int, val: FrozenSet[int]) -> None:
+        slot = self.fn_returns.setdefault(fn, {})
+        new = _merge(slot.get(pos), val)
+        if new != slot.get(pos):
+            slot[pos] = new
+            self._changed = True
+
+    def merge_param(self, fn: FnKey, idx: int, val: FrozenSet[int]) -> None:
+        slot = self.param_jit.setdefault(fn, {})
+        new = _merge(slot.get(idx), val)
+        if new != slot.get(idx):
+            slot[idx] = new
+            self._changed = True
+
+    def mark_donates(self, fn: FnKey, idx: int) -> None:
+        s = self.fn_donates.setdefault(fn, set())
+        if idx not in s:
+            s.add(idx)
+            self._changed = True
+
+    def mark_returns_device(self, fn: FnKey) -> None:
+        if fn not in self.fn_returns_device:
+            self.fn_returns_device.add(fn)
+            self._changed = True
+
+    def mark_attr_device(self, key: Tuple[str, str]) -> None:
+        if key not in self.attr_device:
+            self.attr_device.add(key)
+            self._changed = True
+
+    def mark_launch_unit(self, fn: FnKey) -> None:
+        if fn not in self.launch_units:
+            self.launch_units.add(fn)
+            self._changed = True
+
+    def add_site(self, site: JitSite) -> None:
+        for s in self.jit_sites:
+            if s.module is site.module and s.line == site.line:
+                return
+        self.jit_sites.append(site)
+
+
+def _resolve_wrapped(facts: ConcurrencyFacts, module: Module,
+                     cls_qual: Optional[str], expr: Optional[ast.AST],
+                     local_funcs: Dict[str, FnKey]
+                     ) -> Tuple[Optional[FnKey], int, bool]:
+    """jit's wrapped callable -> (unit key, partial-bound count, method?)."""
+    if expr is None:
+        return (None, 0, False)
+    if isinstance(expr, ast.Call):
+        callee = dotted(expr.func)
+        canon = facts._imports[module.name].canonical(callee) \
+            if callee else None
+        if callee in _PARTIAL_NAMES or canon in _PARTIAL_NAMES:
+            inner, bound, is_m = _resolve_wrapped(
+                facts, module, cls_qual, expr.args[0] if expr.args else None,
+                local_funcs)
+            return (inner, bound + max(0, len(expr.args) - 1), is_m)
+        return (None, 0, False)
+    a = self_attr(expr)
+    if a is not None and cls_qual is not None:
+        cf = facts.classes.get(cls_qual)
+        if cf is not None and a in cf.methods:
+            return ((cf.module.name, f"{cf.name}.{a}"), 0, True)
+        return (None, 0, False)
+    if isinstance(expr, ast.Name):
+        if expr.id in local_funcs:
+            return (local_funcs[expr.id], 0, False)
+        key = facts.module_funcs.get((module.name, expr.id))
+        if key is not None:
+            return (key, 0, False)
+    return (None, 0, False)
+
+
+class _Env:
+    """Interpreter state: jit-valued locals, device-tainted locals, and
+    donated-dead names (bare names and ``self.attr`` paths)."""
+
+    __slots__ = ("jit", "taint", "dead", "local_funcs")
+
+    def __init__(self):
+        self.jit: Dict[str, FrozenSet[int]] = {}
+        self.taint: Set[str] = set()
+        self.dead: Dict[str, int] = {}  # name -> donation line
+        self.local_funcs: Dict[str, FnKey] = {}
+
+    def fork(self) -> "_Env":
+        e = _Env()
+        e.jit = dict(self.jit)
+        e.taint = set(self.taint)
+        e.dead = dict(self.dead)
+        e.local_funcs = dict(self.local_funcs)
+        return e
+
+    def join(self, other: "_Env") -> None:
+        for k, v in other.jit.items():
+            self.jit[k] = _merge(self.jit.get(k), v)
+        self.taint |= other.taint
+        for k, v in other.dead.items():
+            self.dead.setdefault(k, v)
+        self.local_funcs.update(other.local_funcs)
+
+
+class _DeviceScan:
+    """Statement-ordered abstract interpretation of one unit.
+
+    Two modes: the fixpoint pass updates the global maps on
+    :class:`DeviceFacts`; the report pass (``report=True``) additionally
+    emits use-after-donate and host-sync findings.
+    """
+
+    def __init__(self, dev: DeviceFacts, unit: UnitFacts,
+                 report: bool = False):
+        self.dev = dev
+        self.facts = dev.facts
+        self.unit = unit
+        self.report = report
+        self.env = _Env()
+        self.cls = self.facts.classes.get(unit.cls) if unit.cls else None
+        self.hot_depth = 0
+        self._param_names = self._params()
+        self._is_method = bool(self._param_names) \
+            and self._param_names[0] == "self"
+        self._hot_loop_ids = dev.hot_loops.get(unit.key, set()) \
+            if report else set()
+        self._unit_hot = unit.key in dev.hot_units if report else False
+
+    def _params(self) -> List[str]:
+        args = getattr(self.unit.node, "args", None)
+        if args is None:
+            return []
+        return [a.arg for a in (list(getattr(args, "posonlyargs", []))
+                                + list(args.args))]
+
+    def seed_params(self) -> None:
+        known = self.dev.param_jit.get(self.unit.key, {})
+        for i, name in enumerate(self._param_names):
+            if i in known:
+                self.env.jit[name] = known[i]
+
+    def run(self) -> None:
+        self.seed_params()
+        self.exec_block(self.unit.node.body)
+
+    # -- control flow --------------------------------------------------------
+
+    def exec_block(self, stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            self.exec_stmt(s)
+
+    def exec_stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._loop(node, has_target=True)
+        elif isinstance(node, ast.While):
+            self._loop(node, has_target=False)
+        elif isinstance(node, ast.If):
+            self._check_reads(node.test)
+            self._walk_calls(node.test)
+            a, b = self.env.fork(), self.env.fork()
+            saved = self.env
+            self.env = a
+            self.exec_block(node.body)
+            self.env = b
+            self.exec_block(node.orelse)
+            a.join(b)
+            self.env = a
+            saved.jit, saved.taint = a.jit, a.taint
+            saved.dead, saved.local_funcs = a.dead, a.local_funcs
+            self.env = saved
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.eval_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars,
+                                 None, False)
+            self.exec_block(node.body)
+        elif isinstance(node, ast.Try):
+            self.exec_block(node.body)
+            for h in node.handlers:
+                self.exec_block(h.body)
+            self.exec_block(node.orelse)
+            self.exec_block(node.finalbody)
+        elif isinstance(node, ast.Assign):
+            self._exec_assign(node.targets, node.value)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._exec_assign([node.target], node.value)
+        elif isinstance(node, ast.AugAssign):
+            self._check_reads(node.value)
+            self._walk_calls(node.value)
+            # ``x += 1`` reads x even though the target ctx is Store.
+            tkey = self._expr_key(node.target)
+            if self.report and tkey is not None \
+                    and tkey in self.env.dead:
+                self._emit_uad(node.target.lineno, tkey,
+                               self.env.dead.pop(tkey))
+            t = self.taint_of(node.value)
+            self._assign(node.target, None, t or self._tainted_target(
+                node.target))
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self._exec_return(node.value)
+        elif isinstance(node, ast.Expr):
+            self.eval_expr(node.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = f"{self.unit.key[1]}.<locals>.{node.name}"
+            self.env.local_funcs[node.name] = (self.unit.module.name, sub)
+        elif isinstance(node, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.eval_expr(child)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._kill_target(t)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.eval_expr(child)
+
+    def _loop(self, node, has_target: bool) -> None:
+        hot = id(node) in self._hot_loop_ids
+        if hot:
+            self.hot_depth += 1
+        for _pass in range(2):
+            if has_target:
+                it_taint = self.taint_of(node.iter)
+                self._check_reads(node.iter)
+                self._assign(node.target, None, it_taint)
+            self.exec_block(node.body)
+        self.exec_block(node.orelse)
+        if hot:
+            self.hot_depth -= 1
+
+    # -- assignment / return -------------------------------------------------
+
+    def _exec_assign(self, targets: List[ast.expr], value: ast.expr) -> None:
+        self._check_reads(value)
+        self._walk_calls(value)
+        jv = self.jit_of(value)
+        tv = self.taint_of(value)
+        # Donated positions consumed by this very statement's call are
+        # revived by its own targets (the rebinding idiom).
+        rebound = self._target_keys(targets)
+        self._apply_donations(value, rebound)
+        per_elem: Optional[List[Optional[FrozenSet[int]]]] = None
+        if isinstance(value, ast.Tuple):
+            per_elem = [self.jit_of(e) for e in value.elts]
+        elif isinstance(value, ast.Call):
+            per_elem = self._call_elem_returns(value)
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)) and per_elem is not None \
+                    and len(t.elts) == len(per_elem):
+                for el, ejv in zip(t.elts, per_elem):
+                    self._assign(el, ejv, tv)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    self._assign(el, UNKNOWN if jv == UNKNOWN else None, tv)
+            else:
+                self._assign(t, jv, tv)
+
+    def _call_elem_returns(self, call: ast.Call
+                           ) -> Optional[List[Optional[FrozenSet[int]]]]:
+        """Per-tuple-position jit info of a resolved call's return."""
+        key, _off = self._resolve_call(call)
+        if key is None:
+            return None
+        ret = self.dev.fn_returns.get(key)
+        if not ret:
+            return None
+        positions = [p for p in ret if p >= 0]
+        if not positions:
+            return None
+        return [ret.get(i) for i in range(max(positions) + 1)]
+
+    def _exec_return(self, value: ast.expr) -> None:
+        self._check_reads(value)
+        if isinstance(value, ast.Tuple):
+            for i, e in enumerate(value.elts):
+                jv = self.jit_of(e)
+                if jv is not None:
+                    self.dev.merge_return(self.unit.key, i, jv)
+                if self.taint_of(e):
+                    self.dev.mark_returns_device(self.unit.key)
+        else:
+            jv = self.jit_of(value)
+            if jv is not None:
+                self.dev.merge_return(self.unit.key, -1, jv)
+            if self.taint_of(value):
+                self.dev.mark_returns_device(self.unit.key)
+        self.eval_expr(value)
+
+    def _assign(self, target: ast.expr, jv: Optional[FrozenSet[int]],
+                tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.env.dead.pop(target.id, None)
+            if jv is not None:
+                self.env.jit[target.id] = _merge(
+                    self.env.jit.get(target.id), jv)
+            else:
+                self.env.jit.pop(target.id, None)
+            if tainted:
+                self.env.taint.add(target.id)
+            else:
+                self.env.taint.discard(target.id)
+            return
+        a = self_attr(target)
+        if a is not None and self.cls is not None:
+            self.env.dead.pop(f"self.{a}", None)
+            if jv is not None:
+                self.dev.merge_attr_jit((self.cls.qual, a), jv, False)
+            if tainted:
+                self.dev.mark_attr_device((self.cls.qual, a))
+            return
+        if isinstance(target, ast.Subscript):
+            d = self_attr(target.value)
+            if d is not None and self.cls is not None:
+                if jv is not None:
+                    self.dev.merge_attr_jit((self.cls.qual, d), jv, True)
+                if tainted:
+                    self.dev.mark_attr_device((self.cls.qual, d))
+            self.eval_expr(target.value)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, jv, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, jv, tainted)
+
+    def _kill_target(self, t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            self.env.jit.pop(t.id, None)
+            self.env.taint.discard(t.id)
+            self.env.dead.pop(t.id, None)
+
+    def _target_keys(self, targets: Sequence[ast.expr]) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            else:
+                a = self_attr(t)
+                if a is not None:
+                    out.add(f"self.{a}")
+        return out
+
+    def _tainted_target(self, t: ast.expr) -> bool:
+        key = self._expr_key(t)
+        return key is not None and key in self.env.taint
+
+    # -- expression evaluation ----------------------------------------------
+
+    def _expr_key(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        a = self_attr(expr)
+        if a is not None:
+            return f"self.{a}"
+        return None
+
+    def jit_of(self, expr: ast.AST) -> Optional[FrozenSet[int]]:
+        if isinstance(expr, ast.Name):
+            return self.env.jit.get(expr.id)
+        a = self_attr(expr)
+        if a is not None and self.cls is not None:
+            v = self.dev.attr_jit.get((self.cls.qual, a))
+            if v is not None:
+                return v
+            if a in self.cls.jit_attrs:
+                return UNKNOWN
+            return None
+        if isinstance(expr, ast.Attribute):
+            q = self._recv_type(expr.value)
+            if q is not None:
+                return self.dev.attr_jit.get((q, expr.attr))
+            return None
+        if isinstance(expr, ast.Subscript):
+            d = self_attr(expr.value)
+            if d is not None and self.cls is not None:
+                v = self.dev.dict_attr_jit.get((self.cls.qual, d))
+                if v is not None:
+                    return v
+                if d in self.cls.jit_dict_attrs:
+                    return UNKNOWN
+            return None
+        if isinstance(expr, ast.IfExp):
+            return _merge(self.jit_of(expr.body), self.jit_of(expr.orelse))
+        if isinstance(expr, ast.Call):
+            return self._jit_of_call(expr)
+        return None
+
+    def _jit_of_call(self, call: ast.Call) -> Optional[FrozenSet[int]]:
+        callee = dotted(call.func)
+        canon = self._canon(callee) if callee else None
+        if callee in JIT_FACTORIES or canon in JIT_FACTORIES:
+            donate = static = frozenset()
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _literal_argnums(kw.value)
+                elif kw.arg == "static_argnums":
+                    static = _literal_argnums(kw.value)
+            wrapped, bound, is_m = _resolve_wrapped(
+                self.facts, self.unit.module,
+                self.cls.qual if self.cls else None,
+                call.args[0] if call.args else None, self.env.local_funcs)
+            self.dev.add_site(JitSite(
+                module=self.unit.module, line=call.lineno, donate=donate,
+                static=static, wrapped=wrapped, bound=bound,
+                is_method=is_m))
+            return donate
+        if callee in _PARTIAL_NAMES or canon in _PARTIAL_NAMES:
+            inner = self.jit_of(call.args[0]) if call.args else None
+            if inner is None:
+                return None
+            if inner == UNKNOWN:
+                return UNKNOWN
+            n = len(call.args) - 1
+            return frozenset({i - n for i in inner if i - n >= 0})
+        key, _off = self._resolve_call(call)
+        if key is not None:
+            ret = self.dev.fn_returns.get(key)
+            if ret and -1 in ret:
+                return ret[-1]
+            # jit-returning methods indexed by the class layer but whose
+            # donation never resolved stay UNKNOWN-jit (still a launch
+            # when called, never a use-after-donate claim).
+            a = self_attr(call.func)
+            if a is not None and self.cls is not None \
+                    and a in self.cls.jit_returning:
+                return UNKNOWN
+            return None
+        # Wrapper heuristic: an unresolvable call passing a jit value
+        # through returns something jit-shaped with the same donation.
+        for arg in call.args:
+            v = self.jit_of(arg)
+            if v is not None:
+                return v
+        return None
+
+    def _recv_type(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id == "self" \
+                and self.cls is not None:
+            return self.cls.qual
+        if isinstance(expr, ast.Attribute):
+            q = self._recv_type(expr.value)
+            if q is not None and q in self.facts.classes:
+                return self.facts.classes[q].attr_types.get(expr.attr)
+        return None
+
+    def _canon(self, name: str) -> str:
+        return self.facts._imports[self.unit.module.name].canonical(name)
+
+    def _resolve_call(self, call: ast.Call) -> Tuple[Optional[FnKey], int]:
+        """Callee unit key + positional offset (1 for bound methods)."""
+        func = call.func
+        a = self_attr(func)
+        if a is not None and self.cls is not None:
+            if a in self.cls.methods:
+                return ((self.unit.module.name,
+                         f"{self.cls.name}.{a}"), 1)
+            return (None, 0)
+        if isinstance(func, ast.Name):
+            if func.id in self.env.local_funcs:
+                return (self.env.local_funcs[func.id], 0)
+            key = self.facts.module_funcs.get(
+                (self.unit.module.name, func.id))
+            if key is not None:
+                return (key, 0)
+            q = self.facts.resolve_class(func.id, self.unit.module)
+            if q is not None:
+                cf = self.facts.classes[q]
+                if "__init__" in cf.methods:
+                    return ((cf.module.name, f"{cf.name}.__init__"), 1)
+            return (None, 0)
+        if isinstance(func, ast.Attribute):
+            q = self._recv_type(func.value)
+            if q is not None and q in self.facts.classes:
+                cf = self.facts.classes[q]
+                if func.attr in cf.methods:
+                    return ((cf.module.name, f"{cf.name}.{func.attr}"), 1)
+            q2 = self.facts.duck_owner(func.attr, func.value,
+                                       self.unit.module)
+            if q2 is not None:
+                cf = self.facts.classes[q2]
+                if func.attr in cf.methods:
+                    return ((cf.module.name, f"{cf.name}.{func.attr}"), 1)
+        return (None, 0)
+
+    # -- taint ---------------------------------------------------------------
+
+    def taint_of(self, expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.env.taint
+        a = self_attr(expr)
+        if a is not None and self.cls is not None:
+            return (self.cls.qual, a) in self.dev.attr_device
+        if isinstance(expr, ast.Attribute):
+            q = self._recv_type(expr.value)
+            if q is not None and (q, expr.attr) in self.dev.attr_device:
+                return True
+            return self.taint_of(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.taint_of(expr.value)
+        if isinstance(expr, (ast.BinOp,)):
+            return self.taint_of(expr.left) or self.taint_of(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.taint_of(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.taint_of(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            return self.taint_of(expr.left) \
+                or any(self.taint_of(c) for c in expr.comparators)
+        if isinstance(expr, ast.IfExp):
+            return self.taint_of(expr.body) or self.taint_of(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.taint_of(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.taint_of(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._taint_of_call(expr)
+        return False
+
+    def _taint_of_call(self, call: ast.Call) -> bool:
+        callee = dotted(call.func)
+        canon = self._canon(callee) if callee else None
+        # Laundering and host-returning conversions.
+        if callee in _DEVICE_GET or canon in _DEVICE_GET:
+            return False
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in ("float", "int", "bool", "len", "str"):
+            return False
+        if callee in _NP_SINKS or canon in _NP_SINKS:
+            return False
+        if callee in _DEVICE_PUT or canon in _DEVICE_PUT:
+            return True
+        if self.jit_of(call.func) is not None:
+            return True  # launch result
+        key, _off = self._resolve_call(call)
+        if key is not None:
+            if key in self.dev.fn_returns_device:
+                return True
+            return False
+        # Unresolved call (jnp ops, tree maps): tainted args taint result.
+        if isinstance(call.func, ast.Attribute) \
+                and self.taint_of(call.func.value):
+            return True
+        return any(self.taint_of(arg) for arg in call.args) \
+            or any(self.taint_of(kw.value) for kw in call.keywords)
+
+    # -- findings ------------------------------------------------------------
+
+    def _in_hot(self) -> bool:
+        return self._unit_hot or self.hot_depth > 0
+
+    def _emit_sync(self, line: int, desc: str) -> None:
+        if not (self.report and self._in_hot()):
+            return
+        self.dev.sync_findings.append(Finding(
+            rule=SYNC_RULE_ID, path=self.unit.module.relpath, line=line,
+            message=(f"device value flows into {desc} on the hot "
+                     "(compiled-launch) path — an implicit synchronous "
+                     "fetch per iteration; pull it once via "
+                     "jax.device_get at an explicit fetch point"),
+            symbol=self.unit.key[1]))
+
+    def _emit_uad(self, line: int, name: str, donated_line: int) -> None:
+        if not self.report:
+            return
+        self.dev.uad_findings.append(Finding(
+            rule=UAD_RULE_ID, path=self.unit.module.relpath, line=line,
+            message=(f"`{name}` was passed in a donated position of the "
+                     f"compiled call at line {donated_line} and read "
+                     "again without being rebound to the call's result "
+                     "(donated buffers are dead after launch)"),
+            symbol=self.unit.key[1]))
+
+    def _check_reads(self, expr: ast.AST) -> None:
+        """Flag Loads of donated-dead names inside ``expr``."""
+        if not self.report or not self.env.dead:
+            return
+        for node in ast.walk(expr):
+            key = None
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                key = node.id
+            else:
+                a = self_attr(node)
+                if a is not None and isinstance(
+                        getattr(node, "ctx", None), ast.Load):
+                    key = f"self.{a}"
+            if key is not None and key in self.env.dead:
+                self._emit_uad(node.lineno, key, self.env.dead.pop(key))
+
+    def _apply_donations(self, expr: ast.AST, rebound: Set[str]) -> None:
+        """After a statement's call(s), mark donated args dead."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            donated = self._donated_positions(node)
+            for pos in donated:
+                if pos < 0 or pos >= len(node.args):
+                    continue
+                key = self._expr_key(node.args[pos])
+                if key is None or key in rebound:
+                    continue
+                self.env.dead[key] = node.lineno
+
+    def _donated_positions(self, call: ast.Call) -> Set[int]:
+        jv = self.jit_of(call.func)
+        if jv is not None and jv != UNKNOWN:
+            return set(jv)
+        if jv == UNKNOWN:
+            return set()
+        key, off = self._resolve_call(call)
+        if key is not None:
+            return set(self.dev.fn_donates.get(key, ()))
+        return set()
+
+    # -- the main expression walk --------------------------------------------
+
+    def eval_expr(self, expr: ast.AST) -> None:
+        """Walk an evaluated expression: sink checks, donation deaths,
+        fn_donates / param_jit recording, launch marking."""
+        self._check_reads(expr)
+        self._walk_calls(expr)
+        self._apply_donations(expr, set())
+
+    def _walk_calls(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._visit_call(node)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for comp in node.generators:
+                    if self.taint_of(comp.iter):
+                        for nm in self._target_keys([comp.target]):
+                            self.env.taint.add(nm)
+
+    def _visit_call(self, call: ast.Call) -> None:
+        callee = dotted(call.func)
+        canon = self._canon(callee) if callee else None
+        # Host-sync sinks.
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in ("float", "int", "bool") and call.args:
+            if any(self.taint_of(a) for a in call.args):
+                self._emit_sync(call.lineno, f"{call.func.id}()")
+        elif (callee in _NP_SINKS or canon in _NP_SINKS) and call.args:
+            if self.taint_of(call.args[0]):
+                self._emit_sync(call.lineno, "np.asarray()")
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _METHOD_SINKS:
+            if self.taint_of(call.func.value):
+                self._emit_sync(call.lineno, f".{call.func.attr}()")
+        elif callee in ("jax.block_until_ready",) \
+                or canon in ("jax.block_until_ready",):
+            if call.args and self.taint_of(call.args[0]):
+                self._emit_sync(call.lineno, "jax.block_until_ready()")
+        # Launch marking + fn_donates + param_jit propagation.
+        jv = self.jit_of(call.func)
+        if jv is not None:
+            self.dev.mark_launch_unit(self.unit.key)
+            if jv != UNKNOWN:
+                self._record_fn_donates(call, jv, offset=0)
+        key, off = self._resolve_call(call)
+        if key is not None:
+            donates = self.dev.fn_donates.get(key)
+            if donates:
+                self._record_fn_donates(call, donates, offset=0)
+            self._bind_params(call, key, off)
+
+    def _record_fn_donates(self, call: ast.Call, positions, offset: int
+                           ) -> None:
+        """A param of THIS unit passed into a donated position makes this
+        unit donate that caller-visible argument onward."""
+        skip = 1 if self._is_method else 0
+        for pos in positions:
+            if pos < 0 or pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if isinstance(arg, ast.Name) \
+                    and arg.id in self._param_names[skip:]:
+                idx = self._param_names.index(arg.id) - skip
+                if idx >= 0:
+                    self.dev.mark_donates(self.unit.key, idx)
+
+    def _bind_params(self, call: ast.Call, key: FnKey, off: int) -> None:
+        for i, arg in enumerate(call.args):
+            jv = self.jit_of(arg)
+            if jv is not None:
+                self.dev.merge_param(key, i + off, jv)
+        callee_unit = self.facts.units.get(key)
+        if callee_unit is None or not call.keywords:
+            return
+        args = getattr(callee_unit.node, "args", None)
+        if args is None:
+            return
+        names = [a.arg for a in args.args]
+        for kw in call.keywords:
+            if kw.arg and kw.arg in names:
+                jv = self.jit_of(kw.value)
+                if jv is not None:
+                    self.dev.merge_param(key, names.index(kw.arg), jv)
+
+
+# One DeviceFacts per module set, layered on the concurrency cache.
+_DEVICE_CACHE: List[Tuple[Tuple[int, ...], DeviceFacts]] = []
+
+
+def device_facts(modules: Sequence[Module]) -> DeviceFacts:
+    key = tuple(id(m) for m in modules)
+    if _DEVICE_CACHE and _DEVICE_CACHE[0][0] == key:
+        return _DEVICE_CACHE[0][1]
+    dev = DeviceFacts(shared_facts(modules))
+    _DEVICE_CACHE.clear()
+    _DEVICE_CACHE.append((key, dev))
+    return dev
+
+
+class UseAfterDonateRule(Rule):
+    id = UAD_RULE_ID
+    description = ("a name passed in a donated position of a compiled "
+                   "call is read again without being rebound to the "
+                   "call's result")
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        return list(device_facts(modules).uad_findings)
+
+
+class HostSyncRule(Rule):
+    id = SYNC_RULE_ID
+    description = ("a device-tainted value is synchronously fetched "
+                   "(float/int/bool/.item/np.asarray/block_until_ready) "
+                   "inside a hot compiled-launch loop; jax.device_get "
+                   "marks the sanctioned explicit fetch")
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        return list(device_facts(modules).sync_findings)
+
+
+class DonationDisciplineRule(Rule):
+    id = DONATE_RULE_ID
+    description = ("a jitted program mutates-and-returns a parameter "
+                   "pytree without donating that argument — both buffers "
+                   "stay live (double HBM footprint)")
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        dev = device_facts(modules)
+        findings: List[Finding] = []
+        for site in dev.jit_sites:
+            if site.wrapped is None or site.donate == UNKNOWN \
+                    or site.static == UNKNOWN:
+                continue
+            unit = dev.facts.units.get(site.wrapped)
+            if unit is None:
+                continue
+            for pname, jit_idx in self._undonated(site, unit):
+                findings.append(Finding(
+                    rule=self.id, path=site.module.relpath, line=site.line,
+                    message=(f"jitted `{site.wrapped[1]}` mutates-and-"
+                             f"returns parameter `{pname}` (argument "
+                             f"{jit_idx} of the compiled call) without "
+                             "donating it — input and output buffers "
+                             "both stay live (double HBM); add "
+                             f"donate_argnums=({jit_idx},)"),
+                    symbol=unit.key[1]))
+        findings.sort(key=Finding.sort_key)
+        return findings
+
+    def _undonated(self, site: JitSite, unit: UnitFacts
+                   ) -> List[Tuple[str, int]]:
+        args = getattr(unit.node, "args", None)
+        if args is None:
+            return []
+        params = [a.arg for a in (list(getattr(args, "posonlyargs", []))
+                                  + list(args.args))]
+        mutated = self._mutated_names(unit)
+        returned = self._returned_names(unit)
+        if not self._has_return(unit):
+            return []
+        out: List[Tuple[str, int]] = []
+        skip = 1 if site.is_method else 0
+        for i, p in enumerate(params):
+            if p == "self":
+                continue
+            jit_idx = i - skip - site.bound
+            if jit_idx < 0 or jit_idx in site.static:
+                continue
+            if p in mutated or p in returned:
+                if jit_idx not in site.donate:
+                    out.append((p, jit_idx))
+        return out
+
+    @staticmethod
+    def _has_return(unit: UnitFacts) -> bool:
+        return any(isinstance(n, ast.Return) and n.value is not None
+                   for n in ast.walk(unit.node))
+
+    @staticmethod
+    def _mutated_names(unit: UnitFacts) -> Set[str]:
+        """Names feeding a ``mutable=[...]``-listed key of a flax
+        ``.apply`` variables-dict literal anywhere in the unit (nested
+        defs included by name — the megastep's scan body unpacks the
+        loop-carried cache under the same name)."""
+        out: Set[str] = set()
+        for node in ast.walk(unit.node):
+            if not isinstance(node, ast.Call):
+                continue
+            mutable: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "mutable" \
+                        and isinstance(kw.value, (ast.List, ast.Tuple)):
+                    mutable = {e.value for e in kw.value.elts
+                               if isinstance(e, ast.Constant)}
+            if not mutable or not node.args:
+                continue
+            vars_dict = node.args[0]
+            if not isinstance(vars_dict, ast.Dict):
+                continue
+            for k, v in zip(vars_dict.keys, vars_dict.values):
+                if isinstance(k, ast.Constant) and k.value in mutable \
+                        and isinstance(v, ast.Name):
+                    out.add(v.id)
+        return out
+
+    @staticmethod
+    def _returned_names(unit: UnitFacts) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(unit.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            vals = node.value.elts \
+                if isinstance(node.value, ast.Tuple) else [node.value]
+            for v in vals:
+                if isinstance(v, ast.Name):
+                    out.add(v.id)
+        return out

@@ -10,6 +10,11 @@ from distributed_tensorflow_tpu.analysis.concurrency import (
     LockOrderRule,
 )
 from distributed_tensorflow_tpu.analysis.core import Rule
+from distributed_tensorflow_tpu.analysis.device import (
+    DonationDisciplineRule,
+    HostSyncRule,
+    UseAfterDonateRule,
+)
 from distributed_tensorflow_tpu.analysis.hygiene import (
     MutableDefaultRule,
     UnusedImportRule,
@@ -28,6 +33,9 @@ def default_rules() -> List[Rule]:
         LockOrderRule(),
         CrossThreadRaceRule(),
         CollectiveLaunchRule(),
+        UseAfterDonateRule(),
+        HostSyncRule(),
+        DonationDisciplineRule(),
         LayeringRule(),
         UnusedImportRule(),
         MutableDefaultRule(),

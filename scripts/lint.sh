@@ -21,8 +21,10 @@ if [ "${1:-}" = "--changed" ]; then
         | python -m distributed_tensorflow_tpu.analysis --changed-only "$@" \
         || rc=1
 else
-    # Full runs also emit SARIF for CI annotators / editor ingestion.
-    python -m distributed_tensorflow_tpu.analysis \
+    # Full runs also emit SARIF for CI annotators / editor ingestion, and
+    # prune baseline entries whose findings were fixed — stale entries are
+    # errors otherwise, so the baseline only ever shrinks.
+    python -m distributed_tensorflow_tpu.analysis --prune \
         --sarif-out /tmp/dttlint.sarif "$@" || rc=1
 fi
 

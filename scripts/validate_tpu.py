@@ -35,7 +35,9 @@ def main():
             lambda a, b, c: _dense(a, b, c, causal=causal,
                                    scale=1 / np.sqrt(D))
         )(q, k, v)
-        err = float(jnp.max(jnp.abs(got - want)))
+        # Explicit fetch point (dttlint host-sync): one device_get per
+        # config, not an implicit sync inside the launch loop.
+        err = float(jax.device_get(jnp.max(jnp.abs(got - want))))
         print(f"causal={causal}: max_abs_err={err:.3e}")
         # f32 matmuls on the MXU run as bf16 multi-pass by default, in both
         # paths but with different blockings — ~1e-3 is the expected noise.

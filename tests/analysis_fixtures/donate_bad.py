@@ -1,0 +1,44 @@
+"""Seeded device-boundary violations: a donated cache read after the
+launch without rebinding (the engine's donated-cache chaining done
+wrong), both through a local name and through ``self._cache``, plus a
+jitted program that mutates-and-returns its cache parameter WITHOUT
+donating it (the double-HBM footgun).  ``use-after-donate`` and
+``donation-discipline`` must flag exactly the marked lines."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_launch_lock = threading.Lock()
+
+
+class MiniDonatingEngine:
+    def __init__(self, module, params, cache):
+        self.module = module
+        self.params = params
+        self._cache = cache
+        self._step = jax.jit(self._decode_apply, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_apply)  # SEED: donation-discipline
+
+    def _decode_apply(self, params, cache, tok):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def _prefill_apply(self, params, cache, tokens):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def generate(self, cache, tok, steps):
+        for _ in range(steps):
+            with _launch_lock:
+                tok, _new = self._step(self.params, cache, tok)
+            out = jnp.sum(cache)  # SEED: use-after-donate
+        return out
+
+    def refill(self, tokens):
+        with _launch_lock:
+            tok, _ = self._step(self.params, self._cache, tokens)
+        return tok, jnp.sum(self._cache)  # SEED: use-after-donate

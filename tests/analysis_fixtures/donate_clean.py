@@ -1,0 +1,43 @@
+"""Clean twin of ``donate_bad``: identical shape, but every donated
+cache is rebound to the call's result (the documented chaining idiom)
+and the mutating prefill program donates its cache argument.  Zero
+findings expected from ``use-after-donate`` and
+``donation-discipline``."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_launch_lock = threading.Lock()
+
+
+class MiniDonatingEngine:
+    def __init__(self, module, params, cache):
+        self.module = module
+        self.params = params
+        self._cache = cache
+        self._step = jax.jit(self._decode_apply, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_apply, donate_argnums=(1,))
+
+    def _decode_apply(self, params, cache, tok):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def _prefill_apply(self, params, cache, tokens):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def generate(self, cache, tok, steps):
+        for _ in range(steps):
+            with _launch_lock:
+                tok, cache = self._step(self.params, cache, tok)
+            out = jnp.sum(cache)
+        return out
+
+    def refill(self, tokens):
+        with _launch_lock:
+            tok, self._cache = self._step(self.params, self._cache, tokens)
+        return tok, jnp.sum(self._cache)

@@ -1,0 +1,44 @@
+"""Seeded hot-loop host syncs: device values implicitly fetched inside
+the decode loop — ``float()`` / ``bool()`` on launch results, ``.item()``
+in the retire walk, and a stats helper made hot by the CALL GRAPH (not a
+name allowlist) reading a device-tainted attribute.  ``host-sync`` must
+flag exactly the marked lines."""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class MiniSyncEngine:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, tok: tok)
+        self._last = None
+
+    def decode(self, tok, steps):
+        total = 0.0
+        for _ in range(steps):
+            with _launch_lock:
+                tok = self._step(self.params, tok)
+            self._last = tok
+            total += float(tok[0])  # SEED: host-sync
+            total += self._flush_stats()
+            if bool(tok[-1] == 0):  # SEED: host-sync
+                break
+        return total
+
+    def _flush_stats(self):
+        # Hot because decode's iteration loop calls it, not because of
+        # its name.
+        return float(self._last[0])  # SEED: host-sync
+
+    def retire(self, tok_dev, n):
+        outs = []
+        while n > 0:
+            with _launch_lock:
+                tok_dev = self._step(self.params, tok_dev)
+            outs.append(tok_dev.item())  # SEED: host-sync
+            n -= 1
+        return outs

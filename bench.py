@@ -157,10 +157,20 @@ def _serve_bench(flags):
     ``spec_acceptance_rate`` the drafter's realized yield, and
     ``spec_parity`` plus the ``spec_*_parity`` composition keys
     (chunked prefill, prefix cache, megastep) assert greedy output is
-    bit-identical spec on vs off."""
+    bit-identical spec on vs off.
+
+    The per-request sampling A/B replays the continuous traffic with a
+    3-config ``sampling_mix`` (greedy / t0.8k40 / t1.0p0.9):
+    ``sampling_compile_post_warmup`` asserts the heterogeneous mix
+    compiles NOTHING after warmup — per-request params are runtime
+    vectors in one program set — while ``sampling_scalar_program_sets``
+    drives the same three configs through the fixed-batch family, which
+    still keys programs on (temperature, top_k), and counts one
+    compiled set per combo."""
     import dataclasses
 
     import jax
+    import numpy as np
 
     from distributed_tensorflow_tpu import cluster as cluster_lib
     from distributed_tensorflow_tpu.obs import (default_tracer,
@@ -310,6 +320,12 @@ def _serve_bench(flags):
     spec_chunked = dataclasses.replace(spec4, prefill_budget=8)
     spec_mega = dataclasses.replace(spec4, megastep=4)
     spec_prefix = dataclasses.replace(prefix_warm, spec_k=4)
+    # Per-request sampling A/B: the continuous traffic with every request
+    # assigned its own config from a 3-way mix.  Same engine, so every
+    # slot program is already compiled — a heterogeneous mix that
+    # recompiled would show up as compile_post_warmup > 0.
+    mix_spec = "greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2"
+    sampling_mixed = dataclasses.replace(continuous, sampling_mix=mix_spec)
     chunk_engine = engine if on_tpu else ServeEngine(
         "gpt2", mesh=mesh, checkpoint_dir=flags.checkpoint_dir,
         seed=fixed.seed, preset="mini")
@@ -366,6 +382,26 @@ def _serve_bench(flags):
         pershard_res = run_serve(pershard, engine=engine)
         pershard_chunked_res = run_serve(pershard_chunked, engine=engine)
         spec_prefix_res = run_serve(spec_prefix, engine=engine)
+        mixed_res = run_serve(sampling_mixed, engine=engine)
+        assert mixed_res["compile_post_warmup"] == 0, (
+            "heterogeneous sampling mix recompiled after warmup: "
+            f"{mixed_res['compile_post_warmup']} compiles")
+        # Scalar-baseline growth: the fixed-batch family still keys its
+        # programs on (temperature, top_k), so the mix's three configs
+        # cost one compiled set each there — vs the single vectorized
+        # set every slot launch above shared.  Counted as the number of
+        # probed configs that advanced the compile counter (the second
+        # pass re-probes all three to prove the growth is per-config,
+        # not per-call).
+        probe = [np.arange(8, dtype=np.int32)]
+        scalar_configs = ((0.0, 0), (0.8, 40), (1.0, 0))
+        scalar_sets = 0
+        for _ in range(2):
+            for t, k in scalar_configs:
+                before = engine.compile_stats()["compile_total"]
+                engine.generate_batch(probe, 2, temperature=t, top_k=k)
+                if engine.compile_stats()["compile_total"] > before:
+                    scalar_sets += 1
     finally:
         engine.close()
         if chunk_engine is not engine:
@@ -493,6 +529,15 @@ def _serve_bench(flags):
         "spec_prefix_parity": (
             spec_prefix_res["tokens_checksum"]
             == prefix_warm_res["tokens_checksum"]),
+        "sampling_mix": mix_spec,
+        "sampling_configs": mixed_res["sampling_configs"],
+        "sampling_tokens_per_sec": mixed_res["tokens_per_sec"],
+        "sampling_speedup": round(
+            mixed_res["tokens_per_sec"]
+            / max(cont_res["tokens_per_sec"], 1e-9), 3),
+        "sampling_programs_cached": mixed_res["programs_cached"],
+        "sampling_compile_post_warmup": mixed_res["compile_post_warmup"],
+        "sampling_scalar_program_sets": scalar_sets,
         "queue_wait_p50_ms": cont_res["queue_wait_p50_ms"],
         "queue_wait_p99_ms": cont_res["queue_wait_p99_ms"],
         "trace_events": trace_events,

@@ -176,6 +176,13 @@ class DynamicBatcher:
     def submit(self, payload: Any) -> Future:
         """Enqueue one request; returns a Future resolving to its result.
 
+        Payloads are opaque to the batcher.  On the iteration-level path
+        they go straight to ``scheduler.submit_payload``, whose dict form
+        carries per-request options — including ``sampling`` (a
+        ``serve.sampling.SamplingParams`` or kwargs dict): admission never
+        buckets or splits by sampling config, because config rides into
+        the slot programs as runtime vectors, not compile-cache keys.
+
         Raises ``ServeOverloadedError`` when the pending queue is at
         ``max_queue_size`` (admission control) and ``RuntimeError`` after
         ``close()``.

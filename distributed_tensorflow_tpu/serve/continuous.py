@@ -150,6 +150,7 @@ from distributed_tensorflow_tpu.serve.batcher import (
     _percentile,
     _serve_instruments,
 )
+from distributed_tensorflow_tpu.serve import sampling as sampling_lib
 from distributed_tensorflow_tpu.serve.paged import (
     BlockAllocator,
     chain_block_keys,
@@ -256,6 +257,10 @@ class _SlotRequest:
     # Hot reload: the param generation pinned at admission (the request
     # decodes on these weights even if a newer generation lands mid-flight).
     gen: Optional["_ParamGeneration"] = None
+    # Per-request sampling config (frozen SamplingParams; None only before
+    # submit fills it in).  Rides into every launch as one row of the
+    # runtime parameter vectors — never a compile-cache key.
+    sampling: Optional[sampling_lib.SamplingParams] = None
     # Prefix caching: the prompt's chained block content keys, computed
     # once on the submitting thread (pure hashing — no allocator state).
     prefix_keys: List[bytes] = dataclasses.field(default_factory=list)
@@ -414,6 +419,11 @@ class ContinuousScheduler:
         self.eos_token = eos_token
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        # Requests that submit without their own SamplingParams inherit
+        # the scheduler-wide scalars as a per-request config — ONE code
+        # path: every launch builds per-slot vectors, uniform or not.
+        self.default_sampling = sampling_lib.SamplingParams(
+            temperature=self.temperature, top_k=max(0, self.top_k))
         self.cache_mode = cache_mode
         self.block_size = int(block_size)
         shards = 1
@@ -477,6 +487,11 @@ class ContinuousScheduler:
             # exactly to prompt + max_new_tokens).
             self._cache = engine.init_slot_cache(
                 self.num_slots, self.max_total_len + self.spec_k)
+        # Per-slot emitted-token counts (presence/frequency penalties):
+        # resident device state beside the KV cache, donated through every
+        # slot launch and rebound from its return — same chaining idiom
+        # as the cache itself.  Loop-thread state after the ctor.
+        self._counts = engine.init_slot_counts(self.num_slots)
         self.kv_hbm_bytes = int(engine.cache_hbm_bytes(self._cache))
         self.kv_hbm_bytes_per_shard = int(
             engine.cache_hbm_bytes_per_shard(self._cache))
@@ -572,9 +587,19 @@ class ContinuousScheduler:
 
     def submit(self, prompt: np.ndarray, *,
                max_new_tokens: int = 16,
-               eos_token: Optional[int] = None) -> Future:
+               eos_token: Optional[int] = None,
+               sampling=None) -> Future:
         """Enqueue one prompt; Future resolves to its 1-D token array the
         moment ITS slot retires (out of submission order by design).
+
+        ``sampling`` is the request's own config — a
+        ``serve.sampling.SamplingParams`` or a kwargs dict for one
+        (temperature / top_k / top_p / presence_penalty /
+        frequency_penalty / seed); ``None`` inherits the scheduler-wide
+        scalars.  Mixing configs across slots never recompiles: the
+        values ride into ONE compiled program per family as per-slot
+        runtime vectors.  Validation (and TypeError for a bad shape)
+        happens HERE on the submitting thread.
 
         Rejection happens HERE, not mid-decode: a request that can never
         fit its slot (``prompt_len + max_new_tokens > max_total_len``, an
@@ -585,6 +610,8 @@ class ContinuousScheduler:
         Raises ``ServeOverloadedError`` when the admission queue is at
         ``max_queue_size`` and ``RuntimeError`` after ``close()``.
         """
+        sampling = (self.default_sampling if sampling is None
+                    else sampling_lib.coerce(sampling))
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must contain at least one token")
@@ -610,7 +637,8 @@ class ContinuousScheduler:
         req = _SlotRequest(
             prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token=self.eos_token if eos_token is None else eos_token,
-            future=Future(), submitted=time.monotonic())
+            future=Future(), submitted=time.monotonic(),
+            sampling=sampling)
         if self.prefix_cache:
             # Hash the prompt's full blocks HERE on the client thread —
             # pure compute, so the loop thread only ever walks the map.
@@ -642,9 +670,10 @@ class ContinuousScheduler:
 
     def submit_payload(self, payload: Any) -> Future:
         """``DynamicBatcher(iteration_level=True)`` adapter: a raw array is
-        a prompt; a dict carries ``prompt`` plus per-request options; a
-        (prompt, max_new_tokens) tuple is the driver's mixed-traffic
-        shape."""
+        a prompt; a dict carries ``prompt`` plus per-request options
+        (``max_new_tokens``, ``eos_token``, ``sampling`` — a
+        ``SamplingParams`` or kwargs dict); a (prompt, max_new_tokens)
+        tuple is the driver's mixed-traffic shape."""
         if isinstance(payload, dict):
             return self.submit(payload["prompt"], **{
                 k: v for k, v in payload.items() if k != "prompt"})
@@ -750,6 +779,10 @@ class ContinuousScheduler:
         the iteration-level counters: slot occupancy, admissions /
         retirements per iteration, TTFT / TPOT percentiles, and the
         block-pool gauges (trivially full in dense mode)."""
+        # Engine program-cache telemetry: reads dict sizes + internally
+        # locked obs counters only, and runs BEFORE the scheduler lock so
+        # no lock-order edge forms against the launch paths.
+        compile_stats = self.engine.compile_stats()
         with self._lock:
             lat = sorted(self._latencies_ms)
             ttft = sorted(self._ttft_ms)
@@ -757,6 +790,9 @@ class ContinuousScheduler:
             qw = sorted(self._queue_wait_ms)
             iters = self._iterations
             prefix_lookups = self._prefix_hits + self._prefix_misses
+            sampling_configs = len({r.sampling
+                                    for r in self._active.values()
+                                    if r.sampling is not None})
             return {
                 **self._block_stats(),
                 "queue_depth": float(len(self._queue)),
@@ -820,6 +856,12 @@ class ContinuousScheduler:
                 "spec_tokens_per_launch": (
                     self._spec_emitted / self._spec_launches
                     if self._spec_launches else 0.0),
+                # Per-request sampling: distinct configs resident right
+                # now vs the ONE compiled program set serving them all —
+                # the flat-program-count claim, numerically.
+                "sampling_configs_active": float(sampling_configs),
+                "programs_cached": compile_stats["programs_cached"],
+                "compile_total": compile_stats["compile_total"],
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -1111,6 +1153,20 @@ class ContinuousScheduler:
             logger.debug("admitted request into slot %d (prompt %d, "
                          "cached %d)", req.slot, len(req.prompt), start)
 
+    def _sampling_vector(self, decoding: Dict[int, _SlotRequest]):
+        """Full (num_slots,) per-slot sampling vectors for a decode /
+        megastep / verify launch: each occupied slot's own SamplingParams
+        at its emitted-token count (the seeded-key step index); idle rows
+        pad as greedy, the cheapest row of the shared program.  Loop
+        thread only — reads request state the loop owns."""
+        params: List[Optional[sampling_lib.SamplingParams]] = (
+            [None] * self.num_slots)
+        steps = [0] * self.num_slots
+        for slot, req in decoding.items():
+            params[slot] = req.sampling
+            steps[slot] = len(req.tokens)
+        return sampling_lib.pack(params, steps)
+
     def _prefill_step(self) -> None:
         """Spend up to ``prefill_budget`` prompt tokens on the resident
         slots still prefilling, in ``chunk_priority`` order (new requests
@@ -1148,16 +1204,22 @@ class ContinuousScheduler:
             req.prefill_idle = 0
             chunk_start = time.monotonic()
             self._ensure_blocks(req, off + chunk)
-            tok_dev, self._cache = self.engine.prefill_into_slots(
-                self._cache, req.prompt[None, off:off + chunk], [req.slot],
-                temperature=self.temperature, top_k=self.top_k,
-                counter=self._next_counter(), params=req.gen.params,
-                start_offsets=[off] if off else None,
-                **self._paged_call_kwargs())
+            # Only the FINAL chunk's token is emitted — mid-prefill
+            # chunks' outputs are discarded, so only the final chunk
+            # commits to the penalty counts.
+            final = (off + chunk) >= len(req.prompt)
+            tok_dev, self._cache, self._counts = (
+                self.engine.prefill_into_slots(
+                    self._cache, req.prompt[None, off:off + chunk],
+                    [req.slot],
+                    sampling=sampling_lib.pack([req.sampling], [0]),
+                    counts=self._counts, commit=np.array([final]),
+                    counter=self._next_counter(), params=req.gen.params,
+                    start_offsets=[off] if off else None,
+                    **self._paged_call_kwargs()))
             spent += chunk
             req.next_prefill_offset = off + chunk
             req.prefill_chunks += 1
-            final = not req.prefilling()
             if final:
                 tok = int(np.asarray(jax.device_get(tok_dev))[0])
                 req.first_token_at = time.monotonic()
@@ -1262,14 +1324,15 @@ class ContinuousScheduler:
         # iteration's copy is still valid).
         last_in = (self._dev_last_tok if self._dev_last_tok is not None
                    else self._last_tok)
+        samp = self._sampling_vector(decoding)
         launches: List[Tuple[List[int], Any]] = []
         for generation in sorted(by_gen):
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
-            tok_dev, self._cache = self.engine.decode_slots(
+            tok_dev, self._cache, self._counts = self.engine.decode_slots(
                 self._cache, last_in, active,
-                temperature=self.temperature, top_k=self.top_k,
+                sampling=samp, counts=self._counts,
                 counter=self._next_counter(),
                 params=decoding[slots[0]].gen.params,
                 **self._paged_call_kwargs())
@@ -1365,16 +1428,17 @@ class ContinuousScheduler:
         # for the next iteration unconditionally.
         carry = (self._dev_last_tok if self._dev_last_tok is not None
                  else self._last_tok)
+        samp = self._sampling_vector(decoding)
         launches: List[Tuple[List[int], Any]] = []
         for generation in sorted(by_gen):
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
-            toks_dev, carry, steps_dev, self._cache = (
+            toks_dev, carry, steps_dev, self._cache, self._counts = (
                 self.engine.decode_megastep(
                     self._cache, carry, active, horizon, steps=K,
                     eos_rows=eos_rows,
-                    temperature=self.temperature, top_k=self.top_k,
+                    sampling=samp, counts=self._counts,
                     counter=self._next_counter(K),
                     params=decoding[slots[0]].gen.params,
                     **self._paged_call_kwargs()))
@@ -1519,15 +1583,16 @@ class ContinuousScheduler:
         by_gen: Dict[int, List[int]] = {}
         for slot in active_slots:
             by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
+        samp = self._sampling_vector(decoding)
         launches: List[Tuple[List[int], Any, Any]] = []
         for generation in sorted(by_gen):
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
-            targets_dev, accepted_dev, self._cache = (
+            targets_dev, accepted_dev, self._cache, self._counts = (
                 self.engine.verify_slots(
                     self._cache, tokens_in, active, draft_lens,
-                    temperature=self.temperature, top_k=self.top_k,
+                    sampling=samp, counts=self._counts,
                     counter=self._next_counter(K + 1),
                     params=decoding[slots[0]].gen.params,
                     **self._paged_call_kwargs()))

@@ -46,6 +46,7 @@ from distributed_tensorflow_tpu.serve.batcher import (
     DynamicBatcher,
     ServeOverloadedError,
 )
+from distributed_tensorflow_tpu.serve import sampling as sampling_lib
 from distributed_tensorflow_tpu.serve.continuous import ContinuousScheduler
 from distributed_tensorflow_tpu.serve.engine import ServeEngine
 
@@ -144,6 +145,12 @@ class ServeArgs:
     # sampling (greedy argmax when temperature == 0)
     temperature: float = 0.0
     top_k: int = 0
+    # "" = every request uses the scalars above.  A mix spec (e.g.
+    # "greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2") gives each request its own
+    # SamplingParams by deterministic weighted round-robin — requires
+    # --continuous, where the whole mix shares ONE compiled program set
+    # (per-slot runtime vectors, never a compile-cache key).
+    sampling_mix: str = ""
     # mesh axes (data=-1 absorbs the rest, as in train.py)
     data: int = -1
     fsdp: int = 1
@@ -199,10 +206,20 @@ def _prompt_lengths(args: ServeArgs) -> List[int]:
     return lens or [args.prompt_len]
 
 
+def _payload_parts(payload) -> Tuple[np.ndarray, int]:
+    """(prompt, max_new_tokens) of one gpt2 payload — the plain tuple
+    form or the dict form a ``--sampling_mix`` run submits."""
+    if isinstance(payload, dict):
+        return payload["prompt"], payload["max_new_tokens"]
+    return payload
+
+
 def _make_requests(args: ServeArgs, engine: ServeEngine,
                    rng: np.random.Generator):
     """One synthetic payload per request.  gpt2 payloads are (prompt,
-    max_new_tokens) tuples — both paths serve the SAME mixed traffic."""
+    max_new_tokens) tuples — both paths serve the SAME mixed traffic.
+    ``sampling_mix`` upgrades them to dicts carrying each request's own
+    ``SamplingParams`` (same prompts, same horizons)."""
     if args.model == "gpt2":
         vocab = engine.module.cfg.vocab_size
         lens = _prompt_lengths(args)
@@ -210,6 +227,10 @@ def _make_requests(args: ServeArgs, engine: ServeEngine,
         # Shared-prefix mix: request i carries system prompt i % K plus
         # its own random tail of the cycled length — the distinct-prefix
         # groups are what the prefix cache's hit rate is measured over.
+        assigner = None
+        if args.sampling_mix:
+            assigner = sampling_lib.MixAssigner(
+                sampling_lib.parse_sampling_mix(args.sampling_mix))
         prefixes = None
         if args.shared_prefix_len > 0:
             prefixes = [
@@ -232,7 +253,14 @@ def _make_requests(args: ServeArgs, engine: ServeEngine,
             prompt = (tail if prefixes is None
                       else np.concatenate([prefixes[i % len(prefixes)],
                                            tail]))
-            payloads.append((prompt, horizons[i % len(horizons)]))
+            if assigner is None:
+                payloads.append((prompt, horizons[i % len(horizons)]))
+            else:
+                payloads.append({
+                    "prompt": prompt,
+                    "max_new_tokens": horizons[i % len(horizons)],
+                    "sampling": assigner.next(),
+                })
         return payloads
     batch = next(engine.workload.data_fn(max(2, args.max_batch_size)))
     n = len(next(iter(batch.values())))
@@ -295,7 +323,9 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
     if args.continuous:
         cfg = engine.module.cfg
         need = max(p.shape[0] + m for p, m in
-                   _make_requests(args, engine, np.random.default_rng(0)))
+                   map(_payload_parts,
+                       _make_requests(args, engine,
+                                      np.random.default_rng(0))))
         scheduler = ContinuousScheduler(
             engine,
             num_slots=args.num_slots,
@@ -343,7 +373,8 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
 
     cfg = engine.module.cfg
     need = max(p.shape[0] + m for p, m in
-               _make_requests(args, engine, np.random.default_rng(0)))
+               map(_payload_parts,
+                   _make_requests(args, engine, np.random.default_rng(0))))
     overrides: Dict[str, Any] = {}
     preset = _auto_preset(args)
     if preset:
@@ -405,14 +436,15 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
         warm_sched = ContinuousScheduler(
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
-                              max(p.shape[0] + m for p, m in payloads)),
+                              max(p.shape[0] + m for p, m in
+                                  map(_payload_parts, payloads))),
             temperature=args.temperature, top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **warm_kwargs)
-        lengths = sorted({p.shape[0] for p, _ in payloads})
+        lengths = sorted({_payload_parts(p)[0].shape[0] for p in payloads})
         warm_lengths = set(lengths)
         if args.prefix_cache and args.shared_prefix_len > 0:
             # Suffix shapes the timed run will launch once each group's
@@ -427,7 +459,8 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
                     warm_lengths.add(length - s)
         futs = []
         for length in sorted(warm_lengths):
-            donor = next(p for p, _ in payloads if p.shape[0] >= length)
+            donor = next(p for p, _ in map(_payload_parts, payloads)
+                         if p.shape[0] >= length)
             futs.append(warm_sched.submit(donor[:length],
                                           max_new_tokens=2))
         for f in futs:
@@ -442,6 +475,11 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
 
 
 def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
+    if args.sampling_mix and not (args.model == "gpt2" and args.continuous):
+        raise ValueError(
+            "--sampling_mix requires the continuous gpt2 path "
+            "(--continuous); per-request sampling rides the slot "
+            "programs' runtime vectors")
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
     is_lm = args.model == "gpt2"
@@ -481,6 +519,10 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             if (i + 1) % args.log_every == 0:
                 monitor.log(i + 1)
 
+    # Compile counter AFTER warm + batcher construction: everything the
+    # timed window compiles on top of this is a warmup gap (and, under a
+    # sampling mix, a one-program-set violation the bench asserts on).
+    compile_warm = engine.compile_stats()["compile_total"]
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(c,), daemon=True)
                for c in range(max(1, args.clients))]
@@ -540,6 +582,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         "queue_wait_p99_ms": round(stats.get("queue_wait_p99_ms", 0.0), 3),
         "checkpoint_step": engine.restored_step,
     }
+    cstats = engine.compile_stats()
+    out["programs_cached"] = int(cstats["programs_cached"])
+    out["compile_total"] = int(cstats["compile_total"])
+    out["compile_post_warmup"] = int(cstats["compile_total"] - compile_warm)
+    if args.sampling_mix:
+        out["sampling_mix"] = args.sampling_mix
+        out["sampling_configs"] = len(
+            sampling_lib.parse_sampling_mix(args.sampling_mix))
     if interrupted:
         out["drained"] = True
     if fleet:
@@ -616,7 +666,8 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             out["tokens_checksum"] = h.hexdigest()[:16]
         # Sanity surface for smoke tests: every delivered result honors
         # its horizon (a drained run only checks what actually finished).
-        assert all(len(r) == m for r, (_, m) in zip(results, done_payloads))
+        assert all(len(r) == _payload_parts(pl)[1]
+                   for r, pl in zip(results, done_payloads))
     else:
         out["examples_per_sec"] = round(completed / max(elapsed, 1e-9), 2)
         out["predictions"] = results[: min(8, len(results))]

@@ -41,6 +41,7 @@ from distributed_tensorflow_tpu.parallel.sharding import (
     apply_shardings,
     batch_sharding,
 )
+from distributed_tensorflow_tpu.serve import sampling as sampling_lib
 
 logger = logging.getLogger(__name__)
 PyTree = Any
@@ -68,6 +69,16 @@ def _engine_instruments(registry=None):
         "compiles": r.counter(
             "dtt_serve_compile_events_total",
             "Program-cache misses by program kind", labelnames=("kind",)),
+        "compile_total": r.counter(
+            "dtt_serve_compile_total",
+            "Serving program compiles (program-cache misses, all kinds) "
+            "since engine start — flat after warmup is the no-recompile "
+            "claim the bench A/B asserts under mixed sampling traffic"),
+        "programs_cached": r.gauge(
+            "dtt_serve_programs_cached",
+            "Distinct compiled serving programs resident in the "
+            "program caches — ONE set per (family, paged, K/k) "
+            "regardless of the sampling parameter mix"),
         "prefill": r.histogram(
             "dtt_serve_prefill_seconds",
             "Host-side slot-prefill dispatch duration"),
@@ -84,9 +95,12 @@ def _engine_instruments(registry=None):
     }
 
 
-def _select_next(logits: jax.Array, rng, counter, temperature: float,
-                 top_k: int) -> jax.Array:
-    """Next-token selection over (B, V) last-position logits.
+def _select_next_scalar(logits: jax.Array, rng, counter, temperature: float,
+                        top_k: int) -> jax.Array:
+    """Scalar-config next-token selection over (B, V) last-position
+    logits — the fixed-batch ``generate`` family, whose programs stay
+    keyed by the (canonicalized) scalar config and anchor the
+    vector-vs-scalar bit-parity suite.
 
     ``temperature <= 0`` is greedy argmax (the default, and what every
     parity test pins).  Otherwise temperature/top-k sampling with the
@@ -103,6 +117,105 @@ def _select_next(logits: jax.Array, rng, counter, temperature: float,
         scaled = jnp.where(scaled < kth, jnp.finfo(jnp.float32).min, scaled)
     key = jax.random.fold_in(rng, jnp.asarray(counter).astype(jnp.uint32))
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def _select_next(logits: jax.Array, rng, counter, sampling,
+                 counts: jax.Array) -> jax.Array:
+    """Vectorized per-ROW next-token selection over (B, V) last-position
+    logits — ONE compiled program for any mix of per-request configs.
+
+    ``sampling`` is the per-row vector dict (``serve.sampling.pack``):
+    ``temperature``/``top_k``/``top_p``/``presence``/``frequency``/
+    ``seed``/``step``, each (B,) and all RUNTIME arrays — varying them
+    never recompiles.  ``counts`` is the (B, V) emitted-token count
+    matrix the penalties read.  Per-row semantics, each an EXACT no-op
+    at its default so a uniform vector is bit-identical to the old
+    scalar program:
+
+    - penalties first: ``logits - presence * (count > 0) - frequency *
+      count`` (subtracting exact f32 zeros at 0.0 penalties);
+    - ``temperature <= 0`` rows take penalized argmax via the final
+      ``jnp.where`` — greedy rows ride the same program (greedy-row
+      equivalence);
+    - per-row top-k keeps the k highest logits (k-th largest via ONE
+      ascending sort + ``take_along_axis``; ``k <= 0`` lowers the
+      threshold to -inf, keeping all) — the same mask values the scalar
+      static-k path computed;
+    - per-row top-p keeps the smallest descending-sorted nucleus whose
+      EXCLUSIVE cumulative softmax mass is below p (the argmax always
+      survives), mapped back through the inverse permutation; ``p = 1``
+      rows pass through untouched;
+    - rows with ``seed < 0`` draw from the shared
+      ``fold_in(rng, counter)`` key over the whole (B, V) batch — the
+      categorical the scalar program ran; rows with a seed derive a
+      private key from ``fold_in(key(seed), 0x5EED, step)`` so their
+      stream depends only on (seed, params, history), never on batch
+      composition, counter interleaving, megastep K, or spec k.
+    """
+    logits = logits.astype(jnp.float32)
+    temps = sampling["temperature"]
+
+    def _all_greedy(_):
+        # Fast branch: every row greedy AND unpenalized, so the epilogue
+        # is exactly the pre-vectorization argmax — no RNG, no sorts.
+        # Subtracting the all-zero penalties is bit-exact (x - 0.0 == x),
+        # so skipping them changes nothing.
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _mixed(_):
+        counts_f = counts.astype(jnp.float32)
+        penalized = (logits
+                     - sampling["presence"][:, None]
+                     * (counts_f > 0).astype(jnp.float32)
+                     - sampling["frequency"][:, None] * counts_f)
+        greedy_tok = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
+        scaled = penalized / jnp.where(temps > 0.0, temps, 1.0)[:, None]
+        vocab = scaled.shape[-1]
+        srt = jnp.sort(scaled, axis=-1)  # ascending
+        tk = jnp.clip(sampling["top_k"], 0, vocab)
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(vocab - tk, 0, vocab - 1)[:, None], axis=-1)
+        kth = jnp.where(tk[:, None] > 0, kth, -jnp.inf)
+        scaled = jnp.where(scaled < kth, jnp.finfo(jnp.float32).min, scaled)
+        order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # descending
+        sorted_probs = jax.nn.softmax(
+            jnp.take_along_axis(scaled, order, axis=-1), axis=-1)
+        exclusive_cum = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+        keep = jnp.take_along_axis(
+            exclusive_cum < sampling["top_p"][:, None],
+            jnp.argsort(order, axis=-1), axis=-1)
+        nucleus = (sampling["top_p"] < 1.0)[:, None] & ~keep
+        scaled = jnp.where(nucleus, jnp.finfo(jnp.float32).min, scaled)
+        key = jax.random.fold_in(rng, jnp.asarray(counter).astype(jnp.uint32))
+        shared = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+        def _seeded_row(seed, step, row):
+            rk = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.key(seed.astype(jnp.uint32)), 0x5EED),
+                step.astype(jnp.uint32))
+            return jax.random.categorical(rk, row).astype(jnp.int32)
+
+        seeded = jax.vmap(_seeded_row)(
+            sampling["seed"], sampling["step"], scaled)
+        sampled = jnp.where(sampling["seed"] >= 0, seeded, shared)
+        return jnp.where(temps <= 0.0, greedy_tok, sampled)
+
+    # Runtime dispatch INSIDE the one compiled program: an all-greedy
+    # batch (the default traffic, and every legacy caller) never executes
+    # the RNG/sort epilogue, so vectorization costs greedy decode nothing.
+    return jax.lax.cond(
+        jnp.all((temps <= 0.0)
+                & (sampling["presence"] == 0.0)
+                & (sampling["frequency"] == 0.0)),
+        _all_greedy, _mixed, None)
+
+
+def _bump_counts(counts: jax.Array, rows, toks, inc_mask) -> jax.Array:
+    """+1 at (row, token) where ``inc_mask`` — the emitted-token
+    accounting the presence/frequency penalties read.  Masked rows add 0
+    at whatever (garbage) token they carry, leaving their counts exact."""
+    return counts.at[rows, toks].add(inc_mask.astype(counts.dtype))
 
 
 def pad_rows(arr: np.ndarray, target: int) -> np.ndarray:
@@ -235,26 +348,65 @@ class ServeEngine:
             {"params": params, "cache": cache}, tokens,
             decode=True, mutable=["cache"],
         )
-        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
+        nxt = _select_next_scalar(logits[:, -1, :], rng, counter,
+                                  temperature, top_k)
         return nxt, mutated["cache"]
+
+    @staticmethod
+    def canonical_scalar_key(temperature: float, top_k: int):
+        """Canonical (temperature, top_k) for the surviving scalar-keyed
+        fixed-batch programs.  Every greedy config collapses to
+        ``(0.0, 0)`` — ``temperature <= 0`` ignores both values, so
+        ``(-1.0, 5)`` and ``(0.0, 0)`` are the SAME program and must not
+        compile twice.  Sampled configs normalize representation only
+        (float/int casts, negative top_k clamps to 0 = full vocab)."""
+        if temperature <= 0.0:
+            return (0.0, 0)
+        return (float(temperature), max(0, int(top_k)))
+
+    def _note_compile(self, kind: str) -> None:
+        """Account one program-cache miss: the per-kind labelled counter
+        plus the total the bench A/B asserts stays flat post-warmup.
+        Every miss inserts exactly one never-evicted program, so the
+        resident-program gauge advances here too — the insert site, not
+        a dict-length read, so ``compile_stats`` never has to touch the
+        caches themselves."""
+        self._obs["compiles"].labels(kind=kind).inc()
+        self._obs["compile_total"].inc()
+        self._obs["programs_cached"].inc()
+
+    def compile_stats(self) -> Dict[str, float]:
+        """Compile/program-cache telemetry snapshot.  Reads
+        internally-locked obs metrics only — deliberately takes neither
+        ``_launch_lock`` nor a peek at the program dicts, so the
+        scheduler can call it under its own lock (``stats()``) without a
+        lock-order edge against the launch paths or an unlocked
+        cross-thread dict read."""
+        return {
+            "programs_cached": self._obs["programs_cached"].value,
+            "compile_total": self._obs["compile_total"].value,
+        }
 
     def _decode_step_fn(self, temperature: float, top_k: int) -> Callable:
         """Jitted fixed-batch decode step for one sampling config.  The
         greedy program is EXACTLY the pre-sampling one (no rng/counter
-        arguments), so the default path stays bit-identical."""
+        arguments), so the default path stays bit-identical; greedy keys
+        canonicalize to one program regardless of the (ignored) scalar
+        values."""
+        temperature, top_k = self.canonical_scalar_key(temperature, top_k)
         with _launch_lock:
             if temperature <= 0.0:
                 if "step" not in self._generate_fns:
-                    self._obs["compiles"].labels(kind="decode_step").inc()
+                    self._note_compile("decode_step")
                     self._generate_fns["step"] = jax.jit(
                         self._decode_apply, donate_argnums=(1,))
                 return self._generate_fns["step"]
-            key = ("step", float(temperature), int(top_k))
+            key = ("step", temperature, top_k)
             if key not in self._generate_fns:
-                self._obs["compiles"].labels(kind="decode_step").inc()
+                self._note_compile("decode_step")
                 self._generate_fns[key] = jax.jit(
                     functools.partial(self._sampled_decode_apply,
-                                      float(temperature), int(top_k)),
+                                      temperature, top_k),
                     donate_argnums=(1,))
             return self._generate_fns[key]
 
@@ -265,7 +417,7 @@ class ServeEngine:
 
         key = (batch, total_len)
         if key not in self._cache_init_fns:
-            self._obs["compiles"].labels(kind="cache_init").inc()
+            self._note_compile("cache_init")
 
             def mk():
                 vs = self.module.init(
@@ -304,7 +456,7 @@ class ServeEngine:
                 f"{cfg.n_positions}")
         key = ("slots", num_slots, total_len)
         if key not in self._cache_init_fns:
-            self._obs["compiles"].labels(kind="slot_cache_init").inc()
+            self._note_compile("slot_cache_init")
 
             def mk():
                 vs = self.module.init(
@@ -366,7 +518,7 @@ class ServeEngine:
 
         key = ("paged", num_slots, total_len, paged)
         if key not in self._cache_init_fns:
-            self._obs["compiles"].labels(kind="paged_cache_init").inc()
+            self._note_compile("paged_cache_init")
 
             def mk():
                 vs = self.module.init(
@@ -389,6 +541,54 @@ class ServeEngine:
                 out_shardings=shardings,
             )
         return self._cache_init_fns[key]()
+
+    def init_slot_counts(self, num_slots: int) -> jax.Array:
+        """Device-resident ``(num_slots, vocab)`` int32 emitted-token
+        counts — the per-slot state the presence/frequency penalties read.
+        Lives beside the resident KV cache for the scheduler's lifetime,
+        donated through every slot launch, and reset per slot by the
+        admission prefill (never inherited from a previous occupant).
+        Sharded like the batch dim so count rows live with their slots."""
+        cfg = getattr(self.module, "cfg", None)
+        if cfg is None:
+            raise ValueError(
+                f"model {self.model!r} has no vocab config — slot sampling "
+                f"counts only apply to the decode families")
+        with _launch_lock:
+            return jax.device_put(
+                np.zeros((num_slots, cfg.vocab_size), np.int32),
+                batch_sharding(self.mesh))
+
+    @staticmethod
+    def _slot_count_of(cache: PyTree) -> int:
+        """num_slots of a resident slot/paged cache tree — the trailing
+        dim of its per-slot ``cache_index`` vector."""
+        leaves = []
+
+        def _grab(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name == "cache_index":
+                leaves.append(int(leaf.shape[-1]))
+            return leaf
+
+        jax.tree_util.tree_map_with_path(_grab, cache)
+        if not leaves:
+            raise ValueError("cache tree has no cache_index leaf")
+        return leaves[0]
+
+    def _uniform_sampling(self, cache: PyTree, temperature: float,
+                          top_k: int, rows: Optional[int] = None):
+        """Legacy-scalar adapter: the engine-wide (temperature, top_k)
+        as a uniform per-row vector dict plus fresh zero counts — what a
+        caller that never threads ``sampling``/``counts`` gets.  The
+        vector VALUES are runtime data, so every scalar config maps onto
+        the same compiled program."""
+        n = self._slot_count_of(cache)
+        samp = sampling_lib.uniform(rows if rows is not None else n,
+                                    temperature, top_k)
+        counts = np.zeros((n, int(getattr(self.module, "cfg").vocab_size)),
+                          np.int32)
+        return samp, counts
 
     @staticmethod
     def cache_hbm_bytes(cache: PyTree) -> int:
@@ -439,21 +639,29 @@ class ServeEngine:
         return ({} if paged is None
                 else {"paged": paged, "block_tables": block_tables})
 
-    def _prefill_slots_apply(self, temperature, top_k, paged, params, cache,
-                             tokens, slot_ids, block_tables, rng, counter,
-                             starts):
+    def _prefill_slots_apply(self, paged, params, cache, counts, tokens,
+                             slot_ids, block_tables, rng, counter, starts,
+                             sampling, commit):
         cache = self._reset_slot_rows(cache, slot_ids, starts)
+        # Admission hygiene for the penalty state: a freshly prefilled
+        # slot starts from zero counts, never the previous occupant's.
+        # Idempotent across prefill chunks — nothing commits until the
+        # final chunk, so re-zeroing mid-prefill is a no-op.
+        counts = counts.at[slot_ids].set(0)
         logits, mutated = self.module.apply(
             {"params": params, "cache": cache}, tokens,
             decode=True, slot_ids=slot_ids, mutable=["cache"],
             **self._paged_kwargs(paged, block_tables),
         )
-        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
-        return nxt, mutated["cache"]
+        nxt = _select_next(logits[:, -1, :], rng, counter, sampling,
+                           counts[slot_ids])
+        counts = _bump_counts(counts, slot_ids, nxt, commit)
+        return nxt, mutated["cache"], counts
 
     def prefill_into_slots(self, cache: PyTree, prompts: np.ndarray,
                            slot_ids: np.ndarray, *,
                            temperature: float = 0.0, top_k: int = 0,
+                           sampling=None, counts=None, commit=None,
                            rng=None, counter: int = 0,
                            paged=None, block_tables=None, params=None,
                            start_offsets=None):
@@ -483,20 +691,42 @@ class ServeEngine:
         reload: the scheduler pins each request to the param generation it
         was admitted with).  Params are the NON-donated first argument of
         the jitted program, so an override with the same avals/shardings
-        never recompiles."""
+        never recompiles.
+
+        PER-REQUEST SAMPLING: ``sampling`` is an (n,)-row vector dict
+        (``serve.sampling.pack``) and ``counts`` the resident
+        (num_slots, vocab) emitted-token counts (``init_slot_counts``) —
+        both RUNTIME arguments of ONE compiled program per (paged,)
+        regardless of the parameter mix.  ``commit`` (n,) bool marks rows
+        whose selected token is actually emitted (True for a full or
+        FINAL-chunk prefill; False for mid-prefill chunks whose token is
+        discarded), gating the count bump.  With ``counts`` the return
+        grows to (tokens, cache, counts) and counts is donated alongside
+        the cache; without it the engine synthesizes zero counts and
+        keeps the legacy (tokens, cache) arity, with the scalar
+        ``temperature``/``top_k`` broadcast as a uniform vector — same
+        program either way."""
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim != 2:
             raise ValueError(f"prompts must be (n, T), got {prompts.shape}")
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
-        starts = (np.zeros((prompts.shape[0],), np.int32)
+        n = prompts.shape[0]
+        starts = (np.zeros((n,), np.int32)
                   if start_offsets is None
                   else np.asarray(start_offsets, np.int32))
-        if starts.shape != (prompts.shape[0],):
+        if starts.shape != (n,):
             raise ValueError(
-                f"start_offsets must be ({prompts.shape[0]},), "
-                f"got {starts.shape}")
-        key = ("slot_prefill", float(temperature), int(top_k), paged)
+                f"start_offsets must be ({n},), got {starts.shape}")
+        legacy = counts is None
+        if legacy:
+            sampling, counts = self._uniform_sampling(
+                cache, temperature, top_k, rows=n)
+        elif sampling is None:
+            sampling = sampling_lib.uniform(n, temperature, top_k)
+        commit_mask = (np.ones((n,), bool) if commit is None
+                       else np.asarray(commit, bool))
+        key = ("slot_prefill", paged)
         base = rng if rng is not None else self._sample_rng
         bt = block_tables
         if bt is not None and not isinstance(bt, jax.Array):
@@ -504,19 +734,19 @@ class ServeEngine:
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
-                self._obs["compiles"].labels(kind="slot_prefill").inc()
+                self._note_compile("slot_prefill")
                 self._generate_fns[key] = jax.jit(
-                    functools.partial(self._prefill_slots_apply,
-                                      float(temperature), int(top_k), paged),
-                    donate_argnums=(1,))
-            out = self._generate_fns[key](
-                self.params if params is None else params, cache, prompts,
-                np.asarray(slot_ids, np.int32), bt, base, counter, starts)
+                    functools.partial(self._prefill_slots_apply, paged),
+                    donate_argnums=(1, 2))
+            nxt, cache, counts = self._generate_fns[key](
+                self.params if params is None else params, cache, counts,
+                prompts, np.asarray(slot_ids, np.int32), bt, base, counter,
+                starts, sampling, commit_mask)
         self._obs["prefill"].observe(time.perf_counter() - t0)
-        return out
+        return (nxt, cache) if legacy else (nxt, cache, counts)
 
-    def _decode_slots_apply(self, temperature, top_k, paged, params, cache,
-                            tokens, active, block_tables, rng, counter):
+    def _decode_slots_apply(self, paged, params, cache, counts, tokens,
+                            active, block_tables, rng, counter, sampling):
         if tokens.ndim == 1:
             # Accept the (num_slots,) device output of a previous step /
             # megastep directly — chaining it costs zero host work.
@@ -543,12 +773,14 @@ class ServeEngine:
 
         gated = jax.tree_util.tree_map_with_path(
             _gate, mutated["cache"], cache)
-        nxt = _select_next(logits[:, -1, :], rng, counter, temperature, top_k)
-        return nxt, gated
+        nxt = _select_next(logits[:, -1, :], rng, counter, sampling, counts)
+        counts = _bump_counts(counts, slots, nxt, active)
+        return nxt, gated, counts
 
     def decode_slots(self, cache: PyTree, last_tokens: np.ndarray,
                      active: np.ndarray, *, temperature: float = 0.0,
-                     top_k: int = 0, rng=None, counter: int = 0,
+                     top_k: int = 0, sampling=None, counts=None,
+                     rng=None, counter: int = 0,
                      paged=None, block_tables=None, params=None):
         """One iteration-level decode step over ALL slots: (num_slots, 1)
         tokens against the resident cache, per-slot offsets, inactive
@@ -566,10 +798,25 @@ class ServeEngine:
 
         ``last_tokens`` and ``block_tables`` may already be device arrays
         (the scheduler keeps both resident between iterations); host
-        arrays are transferred as before, so the slow path still works."""
+        arrays are transferred as before, so the slow path still works.
+
+        PER-REQUEST SAMPLING: ``sampling`` is a (num_slots,)-row vector
+        dict and ``counts`` the resident (num_slots, vocab) emitted-token
+        counts — runtime arguments of the ONE program per (paged,); count
+        rows bump at each ACTIVE slot's emitted token.  With ``counts``
+        the return grows to (tokens, cache, counts), counts donated;
+        without it the scalar config broadcasts uniformly and the legacy
+        (tokens, cache) arity holds."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
-        key = ("slot_decode", float(temperature), int(top_k), paged)
+        legacy = counts is None
+        if legacy:
+            sampling, counts = self._uniform_sampling(
+                cache, temperature, top_k)
+        elif sampling is None:
+            sampling = sampling_lib.uniform(
+                self._slot_count_of(cache), temperature, top_k)
+        key = ("slot_decode", paged)
         base = rng if rng is not None else self._sample_rng
         bt = block_tables
         if bt is not None and not isinstance(bt, jax.Array):
@@ -577,21 +824,21 @@ class ServeEngine:
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
-                self._obs["compiles"].labels(kind="slot_decode").inc()
+                self._note_compile("slot_decode")
                 self._generate_fns[key] = jax.jit(
-                    functools.partial(self._decode_slots_apply,
-                                      float(temperature), int(top_k), paged),
-                    donate_argnums=(1,))
+                    functools.partial(self._decode_slots_apply, paged),
+                    donate_argnums=(1, 2))
             tokens_dev = last_tokens
             if not isinstance(tokens_dev, jax.Array):
                 tokens_dev = jax.device_put(
                     np.asarray(tokens_dev, np.int32),
                     batch_sharding(self.mesh))
-            out = self._generate_fns[key](
-                self.params if params is None else params, cache,
-                tokens_dev, np.asarray(active, bool), bt, base, counter)
+            nxt, gated, counts = self._generate_fns[key](
+                self.params if params is None else params, cache, counts,
+                tokens_dev, np.asarray(active, bool), bt, base, counter,
+                sampling)
         self._obs["decode_step"].observe(time.perf_counter() - t0)
-        return out
+        return (nxt, gated) if legacy else (nxt, gated, counts)
 
     def put_replicated(self, arr) -> jax.Array:
         """Device-put a host array fully replicated over the mesh — the
@@ -605,9 +852,9 @@ class ServeEngine:
                 np.asarray(arr),
                 NamedSharding(self.mesh, PartitionSpec()))
 
-    def _megastep_apply(self, steps, temperature, top_k, paged, params,
-                        cache, tokens, active, horizon, eos_rows,
-                        block_tables, rng, counter):
+    def _megastep_apply(self, steps, paged, params, cache, counts, tokens,
+                        active, horizon, eos_rows, block_tables, rng,
+                        counter, sampling):
         """K fused decode iterations as ONE program: a bounded
         ``lax.while_loop`` over the inner step with the whole per-slot
         decode state in the carry, exiting EARLY once every row is dead
@@ -634,7 +881,7 @@ class ServeEngine:
         slots = jnp.arange(num_slots, dtype=jnp.int32)
 
         def _body(state):
-            j, cache, tok, alive, left, toks = state
+            j, cache, counts, tok, alive, left, toks = state
             logits, mutated = self.module.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 decode=True, slot_ids=slots, mutable=["cache"],
@@ -651,30 +898,38 @@ class ServeEngine:
 
             gated = jax.tree_util.tree_map_with_path(
                 _gate, mutated["cache"], cache)
+            # Inner step j sees counts updated by steps < j (penalties
+            # track within the fused window exactly as the K=1 loop
+            # would) and seeded rows advance their per-slot step index.
+            samp_j = dict(sampling)
+            samp_j["step"] = sampling["step"] + j
             nxt = _select_next(logits[:, -1, :], rng, counter + j,
-                               temperature, top_k)
+                               samp_j, counts)
             tok_next = jnp.where(alive, nxt, tok)
+            counts = _bump_counts(counts, slots, tok_next, alive)
             hit_eos = (eos_rows >= 0) & (tok_next == eos_rows)
             left_next = jnp.where(alive, left - 1, left)
             alive_next = alive & ~hit_eos & (left_next > 0)
             toks = jax.lax.dynamic_update_slice(
                 toks, tok_next[:, None], (jnp.int32(0), j))
-            return (j + 1, gated, tok_next, alive_next, left_next, toks)
+            return (j + 1, gated, counts, tok_next, alive_next, left_next,
+                    toks)
 
         def _cond(state):
-            j, _, _, alive, _, _ = state
+            j, _, _, _, alive, _, _ = state
             return (j < steps) & jnp.any(alive)
 
-        init = (jnp.int32(0), cache, tokens, active & (horizon > 0),
+        init = (jnp.int32(0), cache, counts, tokens, active & (horizon > 0),
                 horizon, jnp.zeros((num_slots, steps), jnp.int32))
-        steps_run, cache, tok_final, _, _, toks = jax.lax.while_loop(
+        steps_run, cache, counts, tok_final, _, _, toks = jax.lax.while_loop(
             _cond, _body, init)
-        return toks, tok_final, steps_run, cache
+        return toks, tok_final, steps_run, cache, counts
 
     def decode_megastep(self, cache: PyTree, last_tokens, active: np.ndarray,
                         horizon: np.ndarray, *, steps: int,
                         eos_rows=None, temperature: float = 0.0,
-                        top_k: int = 0, rng=None, counter: int = 0,
+                        top_k: int = 0, sampling=None, counts=None,
+                        rng=None, counter: int = 0,
                         paged=None, block_tables=None, params=None):
         """K decode iterations in ONE compiled program (a bounded
         ``lax.while_loop`` over the step).  Returns (tokens
@@ -701,13 +956,29 @@ class ServeEngine:
 
         ``steps=1`` compiles a one-iteration scan — same math as
         ``decode_slots``, used only when callers want a uniform K
-        interface.  The scheduler routes K=1 through ``decode_slots``."""
+        interface.  The scheduler routes K=1 through ``decode_slots``.
+
+        PER-REQUEST SAMPLING: ``sampling``/``counts`` as in
+        ``decode_slots`` — ONE program per (steps, paged).  Inside the
+        fused window, inner step j selects with ``counter + j`` AND
+        counts updated by the earlier inner steps, and seeded rows fold
+        ``step + j`` into their private key — so penalties and seeded
+        streams are reproducible across megastep sizes.  With ``counts``
+        the return grows to (tokens, final token, steps_run, cache,
+        counts); without it the legacy 4-tuple holds."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         steps = int(steps)
         if steps < 1:
             raise ValueError(f"megastep steps must be >= 1, got {steps}")
-        key = ("slot_megastep", steps, float(temperature), int(top_k), paged)
+        legacy = counts is None
+        if legacy:
+            sampling, counts = self._uniform_sampling(
+                cache, temperature, top_k)
+        elif sampling is None:
+            sampling = sampling_lib.uniform(
+                self._slot_count_of(cache), temperature, top_k)
+        key = ("slot_megastep", steps, paged)
         base = rng if rng is not None else self._sample_rng
         bt = block_tables
         if bt is not None and not isinstance(bt, jax.Array):
@@ -718,26 +989,29 @@ class ServeEngine:
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
-                self._obs["compiles"].labels(kind="slot_megastep").inc()
+                self._note_compile("slot_megastep")
                 self._generate_fns[key] = jax.jit(
-                    functools.partial(self._megastep_apply, steps,
-                                      float(temperature), int(top_k), paged),
-                    donate_argnums=(1,))
+                    functools.partial(self._megastep_apply, steps, paged),
+                    donate_argnums=(1, 2))
             tokens_dev = last_tokens
             if not isinstance(tokens_dev, jax.Array):
                 tokens_dev = jax.device_put(
                     np.asarray(tokens_dev, np.int32).reshape(-1),
                     batch_sharding(self.mesh))
-            out = self._generate_fns[key](
-                self.params if params is None else params, cache,
-                tokens_dev, np.asarray(active, bool),
-                np.asarray(horizon, np.int32), eos, bt, base, counter)
+            toks, tok_final, steps_run, cache, counts = (
+                self._generate_fns[key](
+                    self.params if params is None else params, cache, counts,
+                    tokens_dev, np.asarray(active, bool),
+                    np.asarray(horizon, np.int32), eos, bt, base, counter,
+                    sampling))
         self._obs["megastep"].observe(time.perf_counter() - t0)
-        return out
+        if legacy:
+            return toks, tok_final, steps_run, cache
+        return toks, tok_final, steps_run, cache, counts
 
-    def _verify_slots_apply(self, k, temperature, top_k, paged, params,
-                            cache, tokens, active, draft_lens,
-                            block_tables, rng, counter):
+    def _verify_slots_apply(self, k, paged, params, cache, counts, tokens,
+                            active, draft_lens, block_tables, rng, counter,
+                            sampling):
         """Speculative verify as ONE program: a (num_slots, k+1) forward
         whose input row is [last token, draft_0 .. draft_{k-1}].
 
@@ -768,16 +1042,31 @@ class ServeEngine:
             decode=True, slot_ids=slots, mutable=["cache"],
             **self._paged_kwargs(paged, block_tables),
         )
-        targets = jnp.stack(
-            [_select_next(logits[:, j, :], rng, counter + j,
-                          temperature, top_k) for j in range(k + 1)],
-            axis=1)
+        # Position j's target must see the counts the sequential loop
+        # would have at that token — i.e. with targets 0..j-1 already
+        # committed — so the selection walks a PROVISIONAL counts chain.
+        # Only accepted+bonus targets actually commit (below, from the
+        # ORIGINAL counts), so rejected positions leave no residue.
+        target_list = []
+        provisional = counts
+        for j in range(k + 1):
+            samp_j = dict(sampling)
+            samp_j["step"] = sampling["step"] + j
+            t = _select_next(logits[:, j, :], rng, counter + j,
+                             samp_j, provisional)
+            provisional = _bump_counts(provisional, slots, t, active)
+            target_list.append(t)
+        targets = jnp.stack(target_list, axis=1)
         drafts = tokens[:, 1:]
         pos = jnp.arange(k, dtype=jnp.int32)[None, :]
         match = (drafts == targets[:, :k]) & (pos < draft_lens[:, None])
         accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
         accepted = jnp.where(active, accepted, 0)
         advance = jnp.where(active, accepted + 1, 0)
+        new_counts = counts
+        for j in range(k + 1):
+            new_counts = _bump_counts(new_counts, slots, targets[:, j],
+                                      active & (j < advance))
 
         def _gate(path, new, old):
             name = (path[-1].key if hasattr(path[-1], "key")
@@ -789,11 +1078,12 @@ class ServeEngine:
 
         gated = jax.tree_util.tree_map_with_path(
             _gate, mutated["cache"], cache)
-        return targets, accepted, gated
+        return targets, accepted, gated, new_counts
 
     def verify_slots(self, cache: PyTree, tokens: np.ndarray,
                      active: np.ndarray, draft_lens: np.ndarray, *,
                      temperature: float = 0.0, top_k: int = 0,
+                     sampling=None, counts=None,
                      rng=None, counter: int = 0,
                      paged=None, block_tables=None, params=None):
         """One speculative-decoding verify step over ALL slots.
@@ -807,13 +1097,21 @@ class ServeEngine:
         — at least one token per active row, so a launch never stalls a
         stream.  The cache is donated through the call.
 
-        The program is cached per (k, temperature, top_k, paged) and
-        launched under the process launch lock like every other slot
-        program; ``params`` overrides for hot reload without recompiles.
-        Paged mode needs block coverage for all k+1 written positions up
-        front (``PagedKVConfig.blocks_for_spec``) — rejected drafts'
-        writes land in the slot's own blocks behind its rolled-back
-        index, inactive rows' in the trash block."""
+        The program is cached per (k, paged) and launched under the
+        process launch lock like every other slot program; ``params``
+        overrides for hot reload without recompiles.  Paged mode needs
+        block coverage for all k+1 written positions up front
+        (``PagedKVConfig.blocks_for_spec``) — rejected drafts' writes
+        land in the slot's own blocks behind its rolled-back index,
+        inactive rows' in the trash block.
+
+        PER-REQUEST SAMPLING: ``sampling``/``counts`` as in
+        ``decode_slots`` — position j's target draws with each slot's
+        OWN params at ``counter + j`` (seeded rows: ``step + j``),
+        penalties seeing targets 0..j-1 provisionally committed; only
+        the accepted prefix + bonus token commits to the returned
+        counts.  With ``counts`` the return grows to (targets, accepted,
+        cache, counts); without it the legacy 3-tuple holds."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         tokens = np.asarray(tokens, np.int32)
@@ -823,7 +1121,14 @@ class ServeEngine:
                 f"got {tokens.shape} — a k=0 verify is just the plain "
                 f"decode step; route it there instead")
         k = tokens.shape[1] - 1
-        key = ("slot_verify", k, float(temperature), int(top_k), paged)
+        legacy = counts is None
+        if legacy:
+            sampling, counts = self._uniform_sampling(
+                cache, temperature, top_k)
+        elif sampling is None:
+            sampling = sampling_lib.uniform(
+                self._slot_count_of(cache), temperature, top_k)
+        key = ("slot_verify", k, paged)
         base = rng if rng is not None else self._sample_rng
         bt = block_tables
         if bt is not None and not isinstance(bt, jax.Array):
@@ -831,18 +1136,20 @@ class ServeEngine:
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
-                self._obs["compiles"].labels(kind="slot_verify").inc()
+                self._note_compile("slot_verify")
                 self._generate_fns[key] = jax.jit(
-                    functools.partial(self._verify_slots_apply, k,
-                                      float(temperature), int(top_k), paged),
-                    donate_argnums=(1,))
+                    functools.partial(self._verify_slots_apply, k, paged),
+                    donate_argnums=(1, 2))
             tokens_dev = jax.device_put(tokens, batch_sharding(self.mesh))
-            out = self._generate_fns[key](
-                self.params if params is None else params, cache,
+            targets, accepted, gated, counts = self._generate_fns[key](
+                self.params if params is None else params, cache, counts,
                 tokens_dev, np.asarray(active, bool),
-                np.asarray(draft_lens, np.int32), bt, base, counter)
+                np.asarray(draft_lens, np.int32), bt, base, counter,
+                sampling)
         self._obs["verify"].observe(time.perf_counter() - t0)
-        return out
+        if legacy:
+            return targets, accepted, gated
+        return targets, accepted, gated, counts
 
     def generate(self, prompts: np.ndarray, max_new_tokens: int, *,
                  eos_token: Optional[int] = None, eos_check_every: int = 8,

@@ -246,6 +246,7 @@ class FleetRouter:
         "prefilling_slots", "prefill_backlog_tokens", "prefill_chunks",
         "megastep_launches", "megastep_tokens", "megastep_effective_steps",
         "spec_launches", "spec_drafted", "spec_accepted", "spec_emitted",
+        "programs_cached", "compile_total", "sampling_configs_active",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",

@@ -23,6 +23,9 @@ Examples:
         --max_new_tokens=32           # K fused decode steps per dispatch
     python serve.py --model=gpt2 --continuous --spec_k=4 \
         --prompt_period=4             # speculative decode, repetitive mix
+    python serve.py --model=gpt2 --continuous \
+        --sampling_mix=greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2 \
+        --min_new_tokens=4    # per-request sampling, ONE program set
     python serve.py --model=gpt2 --continuous --metrics_port=9100 \
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
@@ -171,6 +174,14 @@ def parse_args(argv=None):
     p.add_argument("--top_k", type=int, default=defaults.top_k,
                    help="restrict sampling to the k highest logits "
                         "(0 = full vocab); only with --temperature > 0")
+    p.add_argument("--sampling_mix", default=defaults.sampling_mix,
+                   help="per-request sampling mix (requires --continuous): "
+                        "comma-separated <config>:<weight> entries where "
+                        "<config> is 'greedy' or t<temp>/k<top_k>/p<top_p>/"
+                        "a<presence>/f<frequency>/s<seed> runs, e.g. "
+                        "'greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2' — every "
+                        "config batches together in ONE compiled program "
+                        "set ('' = uniform --temperature/--top_k)")
     p.add_argument("--preset", default=None,
                    help="gpt2 config preset (tiny|small|medium); default "
                         "tiny on CPU, medium on TPU")

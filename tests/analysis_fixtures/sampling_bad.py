@@ -1,0 +1,46 @@
+"""Seeded fixture: per-request sampling config flowing into jit compile
+caches — the antipattern the vectorized sampling path removes.  A
+NON-frozen (mutable, unhashable-by-identity) config object lands in the
+program-cache key or gets baked into the jitted callable itself, so
+every distinct request config compiles (and leaks) its own program."""
+
+import dataclasses
+import functools
+
+import jax
+
+
+@dataclasses.dataclass
+class SamplingConfig:
+    """Mutable per-request config — exactly what must NOT key a program."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+
+
+def _apply(cfg, params, tokens):
+    return tokens
+
+
+class BadEngine:
+    def __init__(self):
+        self._cache = {}
+
+    def decode_fn(self, cfg: SamplingConfig):
+        # Per-request config in the compile-cache key: one compiled
+        # program per distinct (mutated!) config object.
+        key = ("slot_decode", cfg)
+        self._cache[key] = jax.jit(_apply)  # SEED: recompile-hazard
+        return self._cache[key]
+
+    def prefill_fn(self, cfg: SamplingConfig):
+        self._cache[("slot_prefill", cfg)] = jax.jit(  # SEED: recompile-hazard
+            _apply)
+        return self._cache[("slot_prefill", cfg)]
+
+    def verify_fn(self, cfg: SamplingConfig):
+        # Baking the mutable config into the jitted callable is the same
+        # hazard without a dict: a fresh partial per request is a fresh
+        # program.
+        fn = jax.jit(functools.partial(_apply, cfg))  # SEED: recompile-hazard
+        return fn

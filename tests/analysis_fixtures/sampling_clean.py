@@ -1,0 +1,40 @@
+"""Clean twin of ``sampling_bad``: sampling config is request STATE,
+not program identity.  The frozen params object is hashable and never
+reaches a compile cache — per-request values ride into ONE jitted
+program as runtime ``(num_slots,)`` vectors (the ``serve.sampling``
+pattern), so the cache keys are static family tags."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0
+    top_k: int = 0
+
+
+def _apply(params, tokens, temperature, top_k):
+    scaled = tokens / jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    return jnp.where(temperature <= 0, jnp.argmax(tokens, -1),
+                     jnp.argmax(scaled, -1))
+
+
+class CleanEngine:
+    def __init__(self):
+        self._cache = {}
+
+    def decode_fn(self):
+        # Static family tag: every request config shares this program.
+        key = ("slot_decode",)
+        if key not in self._cache:
+            self._cache[key] = jax.jit(_apply)
+        return self._cache[key]
+
+    def launch(self, params, tokens, requests):
+        # Per-request values become runtime vectors — never a key.
+        temperature = jnp.asarray([r.temperature for r in requests])
+        top_k = jnp.asarray([r.top_k for r in requests])
+        return self.decode_fn()(params, tokens, temperature, top_k)

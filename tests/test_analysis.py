@@ -176,6 +176,29 @@ class TestRecompileHazard:
             """)
         assert by_rule(findings, "recompile-hazard") == []
 
+    def test_sampling_bad_fixture_fires_at_seeded_lines(self):
+        """The per-request-scalar-in-key antipattern the vectorized
+        sampling path removed: a non-frozen config in the program-cache
+        key (or baked into a jitted partial) fires at every SEED line."""
+        path = REPO_ROOT / "tests" / "analysis_fixtures" / "sampling_bad.py"
+        expected = [i for i, line in
+                    enumerate(path.read_text().splitlines(), 1)
+                    if "# SEED: recompile-hazard" in line]
+        assert expected, f"{path} has no SEED markers"
+        modules, errors = load_modules([path], REPO_ROOT)
+        assert not errors, errors
+        hazards = by_rule(run_rules(modules, default_rules()),
+                          "recompile-hazard")
+        assert sorted(f.line for f in hazards) == expected
+
+    def test_sampling_clean_twin_is_silent(self):
+        """Frozen params + static family keys + runtime vectors — the
+        serve.sampling pattern — produce zero findings from ANY rule."""
+        path = REPO_ROOT / "tests" / "analysis_fixtures" / "sampling_clean.py"
+        modules, errors = load_modules([path], REPO_ROOT)
+        assert not errors, errors
+        assert run_rules(modules, default_rules()) == []
+
     def test_mutable_closure_capture(self, tmp_path):
         findings = lint_source(tmp_path, """\
             import jax

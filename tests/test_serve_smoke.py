@@ -157,6 +157,26 @@ def test_serve_entrypoint_spec_prints_one_json_line():
 
 @pytest.mark.slow
 @pytest.mark.serve_slow
+def test_serve_entrypoint_sampling_mix_prints_one_json_line():
+    out = _run([os.path.join(REPO, "serve.py"), "--model=gpt2",
+                "--continuous",
+                "--sampling_mix=greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2",
+                "--num_slots=8", "--steps=16", "--prompt_lens=6,8",
+                "--max_new_tokens=6", "--min_new_tokens=2"])
+    assert out["scheduler"] == "continuous"
+    assert out["completed"] == 16
+    assert out["sampling_mix"] == "greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2"
+    assert out["sampling_configs"] == 3
+    # The tentpole claim at the entrypoint: a heterogeneous mix shares
+    # ONE compiled program set — nothing compiles after warmup, and the
+    # cache holds the per-family programs, not one per config.
+    assert out["compile_post_warmup"] == 0
+    assert 0 < out["programs_cached"] <= 4
+    assert out["compile_total"] == out["programs_cached"]
+
+
+@pytest.mark.slow
+@pytest.mark.serve_slow
 def test_bench_serve_mode_prints_one_json_line():
     out = _run([os.path.join(REPO, "bench.py"), "--mode=serve",
                 "--serve_requests=16"])
@@ -233,3 +253,15 @@ def test_bench_serve_mode_prints_one_json_line():
     assert out["spec_chunked_parity"] is True
     assert out["spec_megastep_parity"] is True
     assert out["spec_prefix_parity"] is True
+    # the vectorized-sampling claim: a heterogeneous per-request mix
+    # runs on ONE compiled program set (zero post-warmup compiles),
+    # while the scalar fixed-batch path pays one program set per config
+    for key in ("sampling_mix", "sampling_configs",
+                "sampling_tokens_per_sec", "sampling_programs_cached",
+                "sampling_compile_post_warmup",
+                "sampling_scalar_program_sets"):
+        assert key in out, f"missing {key!r} in {out}"
+    assert out["sampling_configs"] == 3
+    assert out["sampling_compile_post_warmup"] == 0
+    assert out["sampling_scalar_program_sets"] == 3
+    assert out["sampling_tokens_per_sec"] > 0

@@ -326,6 +326,24 @@ def _serve_bench(flags):
     # recompiled would show up as compile_post_warmup > 0.
     mix_spec = "greedy:0.5,t0.8k40:0.3,t1.0p0.9:0.2"
     sampling_mixed = dataclasses.replace(continuous, sampling_mix=mix_spec)
+    # Async double-buffering A/B: ONE admission wave (steps == num_slots,
+    # every request resident after the first iterations) of UNIFORM long
+    # horizons — steady-state decode, where dispatch N+1 overlapping
+    # fetch N is the whole story.  No chunked prefill and no churn on
+    # purpose: prefill-dominated phases have no decode launch to keep in
+    # flight, so they count as device idle under BOTH modes and would
+    # dilute the overlap signal the idle-fraction assert pins.  K=2
+    # keeps the host-dispatch share high enough to be worth hiding.
+    async_base = dataclasses.replace(
+        continuous, steps=fixed.num_slots, num_slots=fixed.num_slots,
+        prompt_lens="", prompt_len=8 if not on_tpu else 32,
+        max_new_tokens=64, min_new_tokens=0, clients=fixed.num_slots,
+        megastep=2)
+    async_on = dataclasses.replace(async_base, async_decode=True)
+    # --megastep=auto smoke: the driver resolves K on a throwaway
+    # scheduler BEFORE the timed run, so the run itself must not
+    # compile anything past warmup.
+    mega_auto = dataclasses.replace(async_on, megastep="auto")
     chunk_engine = engine if on_tpu else ServeEngine(
         "gpt2", mesh=mesh, checkpoint_dir=flags.checkpoint_dir,
         seed=fixed.seed, preset="mini")
@@ -386,6 +404,43 @@ def _serve_bench(flags):
         assert mixed_res["compile_post_warmup"] == 0, (
             "heterogeneous sampling mix recompiled after warmup: "
             f"{mixed_res['compile_post_warmup']} compiles")
+        # Async on/off, measured like the megastep arm: discard one
+        # full-size pair (first-run-after-compile penalty), interleave
+        # the arms, best-of-3 per arm.  Parity and the idle-fraction
+        # drop are hard asserts — the overlap claim is not allowed to
+        # regress silently into a tie.
+        async_base_runs, async_on_runs = [], []
+        for i in range(4):
+            order = ((async_base, async_on), (async_on, async_base))[i % 2]
+            for cfg in order:
+                gc.collect()
+                res = run_serve(cfg, engine=engine)
+                if i == 0:
+                    continue
+                (async_base_runs if cfg is async_base
+                 else async_on_runs).append(res)
+        async_base_res = max(
+            async_base_runs, key=lambda r: r["tokens_per_sec"])
+        async_on_res = max(async_on_runs, key=lambda r: r["tokens_per_sec"])
+        async_parity = all(
+            r["tokens_checksum"] == async_base_runs[0]["tokens_checksum"]
+            for r in async_base_runs + async_on_runs)
+        idle_sync = statistics.mean(
+            r["device_idle_fraction"] for r in async_base_runs)
+        idle_async = statistics.mean(
+            r["device_idle_fraction"] for r in async_on_runs)
+        assert async_parity, (
+            "async decode changed greedy output: "
+            + str([r["tokens_checksum"]
+                   for r in async_base_runs + async_on_runs]))
+        assert idle_async < idle_sync, (
+            f"async decode did not shrink device idle: "
+            f"async={idle_async:.4f} vs sync={idle_sync:.4f}")
+        mega_auto_res = run_serve(mega_auto, engine=engine)
+        assert mega_auto_res["compile_post_warmup"] == 0, (
+            "megastep=auto compiled after warmup: "
+            f"{mega_auto_res['compile_post_warmup']} compiles")
+        assert 1 <= mega_auto_res["megastep"] <= 32, mega_auto_res["megastep"]
         # Scalar-baseline growth: the fixed-batch family still keys its
         # programs on (temperature, top_k), so the mix's three configs
         # cost one compiled set each there — vs the single vectorized
@@ -496,6 +551,20 @@ def _serve_bench(flags):
         "megastep_parity": mega_parity,
         "megastep_launches": mega8_res["megastep_launches"],
         "megastep_base_launches": mega_base_res["megastep_launches"],
+        "async_tokens_per_sec": async_on_res["tokens_per_sec"],
+        "async_base_tokens_per_sec": async_base_res["tokens_per_sec"],
+        "async_speedup": round(
+            async_on_res["tokens_per_sec"]
+            / max(async_base_res["tokens_per_sec"], 1e-9), 3),
+        "async_parity": async_parity,
+        "device_idle_fraction_sync": round(idle_sync, 4),
+        "device_idle_fraction_async": round(idle_async, 4),
+        "megastep_auto_selected": mega_auto_res["megastep"],
+        "megastep_auto_compile_post_warmup":
+            mega_auto_res["compile_post_warmup"],
+        "megastep_auto_parity": (
+            mega_auto_res["tokens_checksum"]
+            == async_base_runs[0]["tokens_checksum"]),
         "spec_k": spec4_res["spec_k"],
         "spec_tokens_per_sec": spec4_res["tokens_per_sec"],
         "spec_base_tokens_per_sec": spec_base_res["tokens_per_sec"],

@@ -93,10 +93,17 @@ Fleet extensions (``serve/fleet``):
   ``(num_slots, K)`` fetch.  Greedy output is bit-identical K on vs
   off — megastep is a pure dispatch-granularity change, the same
   scheduling-only contract as chunked prefill.  TPOT attribution for
-  K > 1: the host only observes the megastep-boundary fetch timestamp,
-  so the K gaps inside a megastep are synthesized as equal shares of
-  (fetch time - the slot's previous token time) — per-token cadence
-  inside the device loop is invisible to the host by design.
+  K > 1 anchors to the launch's own device window: the on-device
+  iteration clock reports how many inner steps actually ran, the
+  realized cadence is (fetch - dispatch) / steps_run, and a row's j-th
+  token is stamped dispatch + (j+1) cadences — intra-megastep spread
+  is flattened, but the cadence is the device's, not a share of the
+  host's observation gap (which, async, spans an iteration of host
+  work).  ``megastep="auto"`` defers the choice of K to the scheduler:
+  it samples dispatch cost and per-inner-step device time, picks the
+  smallest power of two with dispatch <= K * step / 2 (clamped to
+  [1, 32]) once both deques hold enough samples, and FREEZES — K is
+  compiled-program identity, so it is chosen once, not chased.
 - SPECULATIVE DECODING — ``spec_k >= 1`` turns each decode iteration
   into draft-and-verify: an n-gram prompt-lookup drafter (NO second
   model — the last up-to-``spec_ngram`` tokens of each slot's own
@@ -128,6 +135,32 @@ Fleet extensions (``serve/fleet``):
   launches per generated token on repetitive/structured text —
   ``spec_emitted / spec_launches`` tokens per launch against the plain
   path's one.
+- ASYNC DOUBLE-BUFFERED DECODE — ``async_decode=True`` splits the
+  megastep into dispatch and fetch halves and reorders the iteration
+  to host scheduling -> dispatch megastep N+1 -> fetch megastep N, so
+  admission, prefill chunking, and retirement bookkeeping overlap the
+  launch already executing on device instead of serializing behind
+  its fetch.  The donated resident cache makes the chain safe: every
+  launch rebinds the cache in the assignment that donates it, the
+  next dispatch consumes device values (token carry + cache) with no
+  host round-trip, and all host syncs route through ``_fetch_host``
+  (the one sanctioned ``jax.device_get``) — the discipline dttlint's
+  ``use-after-donate``/``host-sync`` rules machine-check.  The cost
+  is ONE iteration of admission lag: a request submitted while
+  megastep N is in flight prefills at N+1 (TTFT unchanged — its first
+  token comes from prefill), rides launch N+1, and its first decoded
+  tokens land at N+2's fetch.  A slot admitted mid-flight has its
+  true last token only on host, so dispatch passes per-slot
+  ``fresh_tokens``/``fresh`` vectors and the scan's first step selects
+  them on device (always passed — zeros when nothing is fresh — so
+  compiled-program identity never depends on admission timing).
+  Paths that need the host view current (speculative drafting,
+  seeded-sampling replay, mixed-generation iterations) flush the
+  in-flight launch and fall back to the sync order for that
+  iteration.  Greedy output is bit-identical async on vs off; the
+  observable win is ``device_idle_fraction`` (share of the window
+  with no launch in flight, from the dispatch/fetch spans) going to
+  ~zero on decode-heavy traffic.
 """
 
 from __future__ import annotations
@@ -138,7 +171,7 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -164,6 +197,16 @@ logger = logging.getLogger(__name__)
 # (budget spent on other slots) before it jumps the walk order — bounds
 # an in-progress whale's wait under sustained new-short-prompt traffic.
 _PREFILL_AGE_LIMIT = 4
+
+# Megastep autotune (``megastep='auto'``): evaluate the dispatch/step
+# timing ratio every this many iterations (the slow control loop), with
+# at least this many samples of each before committing.  The first
+# confident pick FREEZES — compiled-program identity must stay stable
+# once traffic is flowing, so autotune trades a late optimum for zero
+# steady-state recompiles.
+_AUTOTUNE_EVERY = 16
+_AUTOTUNE_MIN_SAMPLES = 8
+_AUTOTUNE_MAX_K = 32
 
 
 def _continuous_instruments(registry=None):
@@ -228,6 +271,11 @@ def _continuous_instruments(registry=None):
             "Tokens emitted per slot per verify launch (accepted "
             "drafts + the bonus/correction token)",
             buckets=(1, 2, 3, 4, 6, 8, 12, 16, 32)),
+        "device_idle": r.gauge(
+            "dtt_serve_device_idle_fraction",
+            "Fraction of the decode window the device sat with NO "
+            "launch in flight (gap between a fetch completing and the "
+            "next dispatch) — async decode's target"),
     })
     return out
 
@@ -314,6 +362,36 @@ class _SlotRequest:
 
 
 @dataclasses.dataclass
+class _InflightMegastep:
+    """One dispatched-but-not-fetched megastep launch (async decode).
+
+    Everything the fetch half needs to resolve the launch LATER, after
+    the host has run admission/prefill/retirement against the previous
+    iteration's results: the per-generation launch outputs (device
+    handles — touched only through ``jax.device_get``), a snapshot of
+    which requests were decoding (and how far along each was) at
+    dispatch, and the per-slot token counts the dispatch already charged
+    (``pending``) so the next dispatch's horizons exclude tokens that
+    are still in flight."""
+
+    # [(slots, toks_dev, steps_dev)] — one entry per live generation.
+    launches: List[Tuple[List[int], Any, Any]]
+    # slot -> _SlotRequest snapshot at dispatch (same objects as
+    # self._active; membership frozen at dispatch).
+    decoding: Dict[int, Any]
+    # slot -> prior len(req.tokens) at dispatch (columns before this
+    # launch's output).
+    base_len: Dict[int, int]
+    # slot -> tokens this launch can still emit (min(K, horizon)); the
+    # NEXT dispatch subtracts these from its own horizons.
+    pending: Dict[int, int]
+    steps: int                       # the K this launch compiled with
+    dispatch_t: float                # time.monotonic() at dispatch
+    seq: int                         # _launch_seq at dispatch
+    clock_dev: Any = None            # on-device iteration clock output
+
+
+@dataclasses.dataclass
 class _ParamGeneration:
     """One weight generation: a sharded params tree, its checkpoint-step
     tag, and a refcount of in-flight requests pinned to it.  The scheduler
@@ -364,7 +442,8 @@ class ContinuousScheduler:
         per_shard_kv: bool = False,
         prefix_cache: bool = False,
         prefill_budget: int = 0,
-        megastep: int = 1,
+        megastep: Union[int, str] = 1,
+        async_decode: bool = False,
         spec_k: Optional[int] = None,
         spec_ngram: int = 3,
         name: str = "serve-continuous",
@@ -394,7 +473,19 @@ class ContinuousScheduler:
             raise ValueError(
                 f"prefill_budget must be >= 0 (0 = unchunked one-shot "
                 f"prefill), got {prefill_budget}")
-        if megastep < 1:
+        self.megastep_auto = False
+        if isinstance(megastep, str):
+            if megastep != "auto":
+                raise ValueError(
+                    f"megastep must be an int >= 1 or 'auto' (autotune K "
+                    f"from the observed dispatch/step-time ratio), got "
+                    f"{megastep!r}")
+            # Autotune starts at the classic K=1 launch and re-evaluates
+            # on a slow control loop; once enough timing samples land the
+            # chosen K FREEZES so compiled-program identity stays stable.
+            self.megastep_auto = True
+            megastep = 1
+        elif megastep < 1:
             raise ValueError(
                 f"megastep must be >= 1 (1 = one decode iteration per "
                 f"compiled launch, the classic path), got {megastep}")
@@ -407,8 +498,15 @@ class ContinuousScheduler:
             raise ValueError(
                 f"spec_ngram must be >= 1 (longest history n-gram the "
                 f"prompt-lookup drafter matches), got {spec_ngram}")
+        if self.megastep_auto and spec_k:
+            raise ValueError(
+                "megastep='auto' tunes the fused-decode launch from its "
+                "own dispatch/step timings; speculative decoding replaces "
+                "those launches with draft-and-verify, so there is "
+                "nothing to tune — pick an explicit megastep with spec_k")
         self.engine = engine
         self.megastep = int(megastep)
+        self.async_decode = bool(async_decode)
         self.spec_k = int(spec_k) if spec_k is not None else 0
         self.spec_ngram = int(spec_ngram)
         self.prefill_budget = int(prefill_budget)
@@ -511,6 +609,38 @@ class ContinuousScheduler:
         # table mutation (allocation growth, prefix map, retire reset).
         self._dev_last_tok = None
         self._dev_block_tables = None
+        # Async double-buffering (loop-thread state): slots whose host
+        # copy of the last token is newer than the device carry (a
+        # prefill wrote it while a launch was in flight) — the next
+        # dispatch merges these rows from ``_last_tok`` ON DEVICE via the
+        # engine's fresh-row mask instead of round-tripping the carry.
+        self._fresh = np.zeros((self.num_slots,), bool)
+        # The in-flight megastep launch (async mode): dispatched but not
+        # yet fetched.  Exactly zero or one — double buffering, not a
+        # queue.
+        self._inflight: Optional[_InflightMegastep] = None
+        # On-device iteration clock: cumulative inner decode steps, one
+        # int32 carried launch to launch so K>1 TPOT stamps are anchored
+        # to real device progress.  ``_device_clock`` is the host mirror,
+        # updated at each fetch.
+        self._dev_clock = None
+        self._device_clock = 0
+        # Device-idle accounting: [last-fetch-done .. next-dispatch] gaps
+        # where NO launch was in flight (the device sat idle while the
+        # host scheduled).  ``_launch_seq`` pairs each fetch with the
+        # launch count at its dispatch so an async fetch that already has
+        # a successor in flight contributes no gap.
+        self._launch_seq = 0
+        self._idle_gap_s = 0.0
+        self._await_gap_from: Optional[float] = None
+        self._window_start: Optional[float] = None
+        self._window_end: Optional[float] = None
+        # Megastep autotune (``megastep='auto'``): recent host dispatch
+        # durations vs realized per-inner-step device times; evaluated on
+        # a slow control loop, frozen at the first confident pick.
+        self._dispatch_s: collections.deque = collections.deque(maxlen=64)
+        self._step_s: collections.deque = collections.deque(maxlen=64)
+        self._autotune_frozen = not self.megastep_auto
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: "collections.deque[_SlotRequest]" = collections.deque()
@@ -841,10 +971,22 @@ class ContinuousScheduler:
                 "prefill_backlog_tokens": float(self._prefill_backlog),
                 "prefill_chunks": float(self._prefill_chunks),
                 "megastep": float(self.megastep),
+                "megastep_auto": 1.0 if self.megastep_auto else 0.0,
+                "megastep_autotune_frozen": (
+                    1.0 if self._autotune_frozen else 0.0),
                 "megastep_launches": float(self._megastep_launches),
                 "megastep_tokens": float(self._megastep_tokens),
                 "megastep_effective_steps": float(
                     self._megastep_effective_steps),
+                # Async double buffering: whether the loop dispatches
+                # before fetching, the device-side cumulative inner-step
+                # clock (host mirror, advanced at each fetch), and the
+                # fraction of the decode window the device sat with no
+                # launch in flight — the overlap headline (async on must
+                # shrink it toward zero).
+                "async_decode": 1.0 if self.async_decode else 0.0,
+                "device_clock": float(self._device_clock),
+                "device_idle_fraction": self._idle_fraction_locked(),
                 "spec_k": float(self.spec_k),
                 "spec_launches": float(self._spec_launches),
                 "spec_drafted": float(self._spec_drafted),
@@ -899,86 +1041,8 @@ class ContinuousScheduler:
     def _loop(self) -> None:
         try:
             while True:
-                admits: List[_SlotRequest] = []
-                gen_swapped = False
-                with self._cond:
-                    while (not self._stopped and not self._active
-                           and not self._queue
-                           and self._pending_gen is None):
-                        self._cond.wait()
-                    if self._stopped:
-                        return
-                    if self._pending_gen is not None:
-                        # Install the staged weight generation: every
-                        # admission from here on pins it; rows already
-                        # active keep their own generation's params.
-                        old, self._gen = self._gen, self._pending_gen
-                        self._pending_gen = None
-                        gen_swapped = True
-                        if old.refs == 0:
-                            old.params = None  # nothing in flight holds it
-                        logger.info(
-                            "hot-swapped params: generation %d -> %d "
-                            "(%d request(s) still on the old weights)",
-                            old.generation, self._gen.generation, old.refs)
-                    while (self._queue and self._free
-                           and not self._draining):
-                        idx = self._pick_slot_locked(self._queue[0])
-                        if idx is None:
-                            break  # head of line waits on KV blocks
-                        req = self._queue.popleft()
-                        req.slot = self._free.pop(idx)
-                        if self.paged is not None:
-                            # Reserve the worst-case block count now so a
-                            # mid-decode boundary cross can always be
-                            # served — admission is what waits on blocks,
-                            # never a half-decoded stream.
-                            req.reserved_blocks = self.paged.blocks_for(
-                                req.max_written_tokens())
-                            self._reserved[self._slot_shard[req.slot]] += (
-                                req.reserved_blocks)
-                        req.gen = self._gen
-                        self._gen.refs += 1
-                        admits.append(req)
-                    if (self.paged is not None and self._queue
-                            and self._free
-                            and self._queue[0].blocked_since is None):
-                        # Head of line is waiting on BLOCKS, not slots:
-                        # start its reservation-wait span.
-                        self._queue[0].blocked_since = time.monotonic()
-                    self._obs["depth"].set(len(self._queue))
-                    refill = (self.megastep > 1 and bool(admits)
-                              and bool(self._queue) and bool(self._free)
-                              and not self._draining)
-                if gen_swapped and self.prefix_cache:
-                    # Cached K/V is a function of the weights that wrote
-                    # it: a new generation drops every key (before this
-                    # iteration's admissions, which pin the new params).
-                    # In-flight shares keep their refcounts and free
-                    # normally at retirement.
-                    dropped = self._allocator.invalidate_prefix_cache()
-                    if dropped:
-                        logger.info(
-                            "hot reload invalidated %d prefix-cached "
-                            "block(s)", dropped)
-                self._admit(admits)
-                self._prefill_step()
-                if refill:
-                    # Megastep admission alignment: a K-step launch pins
-                    # its rows for K iterations, so a request that missed
-                    # this boundary by milliseconds would decode phase-
-                    # shifted from its wave forever, wasting masked
-                    # slot-steps at every retirement.  When this iteration
-                    # admitted something and (as of the locked admission
-                    # pass above) the queue and free slots were both
-                    # non-empty, keep admitting and prefilling, THEN
-                    # launch the fused step — rows admitted together
-                    # advance and retire together.  Never taken when this
-                    # iteration admitted nothing (a blocked head of line
-                    # must not starve decode), and a no-op for K=1, whose
-                    # admission granularity is already one step.
-                    continue
-                self._decode_once()
+                if self._iteration():
+                    return
         except BaseException as e:  # noqa: BLE001 — forwarded to futures
             logger.exception("continuous scheduler loop died")
             with self._cond:
@@ -991,6 +1055,117 @@ class ContinuousScheduler:
             for req in doomed:
                 if not req.future.done():
                     req.future.set_exception(e)
+
+    def _iteration(self) -> bool:
+        """One scheduler iteration; True means the loop should exit.
+
+        The host-scheduling half (generation install, admission walk,
+        chunked prefill) runs BEFORE the decode call — with async decode
+        on, that host work overlaps the previous iteration's in-flight
+        device launch instead of alternating with it."""
+        admits: List[_SlotRequest] = []
+        gen_swapped = False
+        host_t0 = time.monotonic()
+        with self._cond:
+            while (not self._stopped and not self._active
+                   and not self._queue
+                   and self._pending_gen is None
+                   and self._inflight is None):
+                self._cond.wait()
+            stopped = self._stopped
+            refill = False
+            if not stopped:
+                if self._pending_gen is not None:
+                    # Install the staged weight generation: every
+                    # admission from here on pins it; rows already
+                    # active keep their own generation's params.
+                    old, self._gen = self._gen, self._pending_gen
+                    self._pending_gen = None
+                    gen_swapped = True
+                    if old.refs == 0:
+                        old.params = None  # nothing in flight holds it
+                    logger.info(
+                        "hot-swapped params: generation %d -> %d "
+                        "(%d request(s) still on the old weights)",
+                        old.generation, self._gen.generation, old.refs)
+                while (self._queue and self._free
+                       and not self._draining):
+                    idx = self._pick_slot_locked(self._queue[0])
+                    if idx is None:
+                        break  # head of line waits on KV blocks
+                    req = self._queue.popleft()
+                    req.slot = self._free.pop(idx)
+                    if self.paged is not None:
+                        # Reserve the worst-case block count now so a
+                        # mid-decode boundary cross can always be
+                        # served — admission is what waits on blocks,
+                        # never a half-decoded stream.
+                        req.reserved_blocks = self.paged.blocks_for(
+                            req.max_written_tokens())
+                        self._reserved[self._slot_shard[req.slot]] += (
+                            req.reserved_blocks)
+                    req.gen = self._gen
+                    self._gen.refs += 1
+                    admits.append(req)
+                if (self.paged is not None and self._queue
+                        and self._free
+                        and self._queue[0].blocked_since is None):
+                    # Head of line is waiting on BLOCKS, not slots:
+                    # start its reservation-wait span.
+                    self._queue[0].blocked_since = time.monotonic()
+                self._obs["depth"].set(len(self._queue))
+                refill = (self.megastep > 1 and bool(admits)
+                          and bool(self._queue) and bool(self._free)
+                          and not self._draining)
+        if stopped:
+            # close() while a launch was in flight: resolve it so its
+            # requests' already-computed tokens retire normally instead
+            # of failing.  Outside the cond block — the fetch takes
+            # self._lock, which is not reentrant.
+            self._flush_inflight()
+            return True
+        if gen_swapped and self.prefix_cache:
+            # Cached K/V is a function of the weights that wrote
+            # it: a new generation drops every key (before this
+            # iteration's admissions, which pin the new params).
+            # In-flight shares keep their refcounts and free
+            # normally at retirement.
+            dropped = self._allocator.invalidate_prefix_cache()
+            if dropped:
+                logger.info(
+                    "hot reload invalidated %d prefix-cached "
+                    "block(s)", dropped)
+        self._admit(admits)
+        self._prefill_step()
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "host_sched", cat="serve", tid=0,
+                start=host_t0, end=time.monotonic(),
+                args={"admitted": len(admits),
+                      "inflight": self._inflight is not None})
+        if refill:
+            # Megastep admission alignment: a K-step launch pins
+            # its rows for K iterations, so a request that missed
+            # this boundary by milliseconds would decode phase-
+            # shifted from its wave forever, wasting masked
+            # slot-steps at every retirement.  When this iteration
+            # admitted something and (as of the locked admission
+            # pass above) the queue and free slots were both
+            # non-empty, keep admitting and prefilling, THEN
+            # launch the fused step — rows admitted together
+            # advance and retire together.  Never taken when this
+            # iteration admitted nothing (a blocked head of line
+            # must not starve decode), and a no-op for K=1, whose
+            # admission granularity is already one step.
+            return False
+        self._decode_once()
+        if self.megastep_auto:
+            with self._lock:
+                due = (not self._autotune_frozen
+                       and self._iterations % _AUTOTUNE_EVERY == 0)
+            if due:
+                self._autotune_eval()
+        return False
 
     def _pick_slot_locked(self, req: _SlotRequest) -> Optional[int]:
         """Index into ``self._free`` of the slot to admit ``req`` into, or
@@ -1221,12 +1396,18 @@ class ContinuousScheduler:
             req.next_prefill_offset = off + chunk
             req.prefill_chunks += 1
             if final:
-                tok = int(np.asarray(jax.device_get(tok_dev))[0])
+                tok = int(self._fetch_host(tok_dev)[0])
                 req.first_token_at = time.monotonic()
                 req.last_token_at = req.first_token_at
                 req.tokens.append(tok)
                 self._last_tok[req.slot, 0] = tok
-                self._dev_last_tok = None  # host token vector is newer
+                if self.async_decode:
+                    # Keep the device carry (a launch may be in flight);
+                    # the next dispatch merges this row from the host
+                    # vector on device via the fresh-row mask.
+                    self._fresh[req.slot] = True
+                else:
+                    self._dev_last_tok = None  # host vector is newer
                 self._register_prefix(req)
             if self._tracer.enabled:
                 now = time.monotonic()
@@ -1290,10 +1471,36 @@ class ContinuousScheduler:
         verify step whenever ANY slot drafted; iterations where no slot
         has a draft fall through HERE — to the plain step or the
         megastep — so a degenerate k=0 verify program is never built or
-        cached."""
+        cached.
+
+        With ``async_decode`` the iteration is double-buffered: dispatch
+        megastep N+1 BEFORE fetching megastep N, so the device starts
+        the next launch while the host resolves the previous one (and
+        the next iteration's admission/prefill overlaps this launch's
+        compute).  Traffic the stale-by-one host view cannot serve
+        (``_needs_sync``) falls back to the synchronous paths after
+        flushing the in-flight launch."""
+        if self.async_decode and not self._needs_sync():
+            rec = self._megastep_dispatch()
+            prev, self._inflight = self._inflight, None
+            if prev is not None:
+                self._megastep_fetch(prev)
+            self._inflight = rec
+            return
+        self._flush_inflight()
+        if self._fresh.any():
+            # Collapse to the sync invariant: with every launch resolved
+            # the host token vector is authoritative again.
+            self._dev_last_tok = None
+            self._fresh[:] = False
         if self.spec_k and self._decode_spec_once():
             return
-        if self.megastep > 1:
+        with self._lock:
+            mega = self.megastep
+        if mega > 1 or self.megastep_auto:
+            # megastep='auto' routes K=1 through the megastep halves too:
+            # the dispatch/step timing samples autotune picks from come
+            # from there.
             self._decode_megastep_once()
             return
         decoding = self._decode_snapshot()
@@ -1344,13 +1551,16 @@ class ContinuousScheduler:
         self._dev_last_tok = launches[0][1] if len(launches) == 1 else None
         toks_by_slot: Dict[int, int] = {}
         for slots, tok_dev in launches:
-            toks = np.asarray(jax.device_get(tok_dev))
+            toks = self._fetch_host(tok_dev)
             for slot in slots:
                 toks_by_slot[slot] = int(toks[slot])
         with self._lock:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
             self._last_occupancy = len(active_slots)
+            self._note_dispatch_locked(iter_start)
+            self._note_fetch_done_locked(
+                self._launch_seq, time.monotonic())
         if self._tracer.enabled:
             self._tracer.add_span(
                 "iteration", cat="serve", tid=0,
@@ -1380,44 +1590,72 @@ class ContinuousScheduler:
                 self._obs["megastep_amortized"].inc(saved)
 
     def _decode_megastep_once(self) -> None:
-        """One megastep iteration: K fused decode steps in ONE launch per
-        live generation, then ONE (num_slots, K) fetch per launch and
-        retirement at the boundary.
+        """One SYNC megastep iteration: dispatch, then fetch immediately
+        — the classic blocking loop.  Async mode routes through the same
+        two halves from ``_decode_once`` with the fetch deferred one
+        iteration, so sync vs async is purely WHEN the fetch runs."""
+        rec = self._megastep_dispatch()
+        if rec is not None:
+            self._megastep_fetch(rec)
+
+    def _megastep_dispatch(self) -> Optional[_InflightMegastep]:
+        """Dispatch half of a megastep iteration: build horizons and eos
+        rows from the host view MINUS tokens still in flight, launch one
+        K-step fused program per live generation, and return the
+        in-flight record the fetch half resolves later.  Returns None
+        when no row can decode.
 
         Block tables are precomputed for all K positions up front —
         coverage clamped to the request's admission reservation, so a
         row whose horizon ends mid-megastep never allocates past what
         admission promised (its one past-horizon garbage write lands in
         its own last block or the trash block, behind the frozen index
-        either way).  The host trims each row's fetched tokens with the
-        same ``req.done()`` walk that retires it, so a row finishing at
-        inner step j < K contributes exactly its first j+1 tokens —
-        bit-identical to the K=1 path — and nothing after its eos leaks
-        into ``req.tokens``.
+        either way).
 
-        TPOT for K > 1 (see the module docstring): the host observes one
-        timestamp per megastep, so a slot's n fetched tokens each get an
-        equal 1/n share of (fetch time - previous token time) as their
-        synthesized inter-token gap.
+        ASYNC DOUBLE BUFFERING: this half may run with the PREVIOUS
+        launch still unfetched.  Per-slot horizons subtract that
+        launch's ``pending`` token counts, so no row ever overruns
+        ``max_new_tokens`` and a row whose remaining horizon is fully
+        in flight sits this launch out.  A row that hit its eos INSIDE
+        the in-flight launch is dispatched once more (the host cannot
+        know yet); its extra tokens are trimmed at fetch and its K/V
+        writes stay inside its own reserved coverage, behind the index
+        reset of the slot's next prefill — the donation-fencing
+        invariant.  Rows whose prefill finished while the launch was in
+        flight carry a ``fresh`` flag: their host first token is merged
+        into the device token carry ON DEVICE (first launch only — later
+        generation groups ride the already-merged carry), so the carry
+        chain never round-trips the host.
         """
+        prev = self._inflight
+        prev_pending = prev.pending if prev is not None else {}
         decoding = self._decode_snapshot()
-        active_slots = list(decoding)
-        if not active_slots:
-            return
-        K = self.megastep
-        iter_start = time.monotonic()
+        with self._lock:
+            K = self.megastep
         horizon = np.zeros((self.num_slots,), np.int32)
         eos_rows = np.full((self.num_slots,), -1, np.int32)
-        for slot in active_slots:
+        active_slots: List[int] = []
+        pending: Dict[int, int] = {}
+        for slot in sorted(decoding):
             req = decoding[slot]
-            horizon[slot] = req.max_new_tokens - len(req.tokens)
+            inflight = prev_pending.get(slot, 0)
+            left = req.max_new_tokens - len(req.tokens) - inflight
+            if left <= 0:
+                continue  # the rest of the horizon is already in flight
+            active_slots.append(slot)
+            pending[slot] = min(K, left)
+            horizon[slot] = left
             if req.eos_token is not None:
                 eos_rows[slot] = req.eos_token
             # Cover all K upcoming positions once, at megastep start —
             # never past the admission reservation (a short-horizon row
             # stops advancing on device before it would need more).
             self._ensure_blocks(req, megastep_coverage(
-                len(req.prompt), len(req.tokens), K, req.max_new_tokens))
+                len(req.prompt), len(req.tokens) + inflight, K,
+                req.max_new_tokens))
+        if not active_slots:
+            return None
+        dispatch_t = time.monotonic()
         by_gen: Dict[int, List[int]] = {}
         for slot in active_slots:
             by_gen.setdefault(decoding[slot].gen.generation, []).append(slot)
@@ -1428,67 +1666,225 @@ class ContinuousScheduler:
         # for the next iteration unconditionally.
         carry = (self._dev_last_tok if self._dev_last_tok is not None
                  else self._last_tok)
+        fresh = fresh_tokens = None
+        if self._dev_last_tok is not None and self._fresh.any():
+            fresh = self._fresh.copy()
+            fresh_tokens = self._last_tok[:, 0].copy()
+        if self._dev_clock is not None:
+            clock = self._dev_clock
+        else:
+            with self._lock:
+                clock = np.int32(self._device_clock)
         samp = self._sampling_vector(decoding)
-        launches: List[Tuple[List[int], Any]] = []
+        launches: List[Tuple[List[int], Any, Any]] = []
         for generation in sorted(by_gen):
             slots = by_gen[generation]
             active = np.zeros((self.num_slots,), bool)
             active[slots] = True
-            toks_dev, carry, steps_dev, self._cache, self._counts = (
+            (toks_dev, carry, steps_dev, clock, self._cache,
+             self._counts) = (
                 self.engine.decode_megastep(
                     self._cache, carry, active, horizon, steps=K,
                     eos_rows=eos_rows,
                     sampling=samp, counts=self._counts,
                     counter=self._next_counter(K),
                     params=decoding[slots[0]].gen.params,
+                    fresh_tokens=fresh_tokens, fresh=fresh, clock=clock,
                     **self._paged_call_kwargs()))
+            fresh = fresh_tokens = None  # the first launch merged them
             launches.append((slots, toks_dev, steps_dev))
         self._dev_last_tok = carry
+        self._dev_clock = clock
+        self._fresh[:] = False
         with self._lock:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
             self._last_occupancy = len(active_slots)
-        fetched = [(slots, np.asarray(jax.device_get(toks_dev)),
-                    int(jax.device_get(steps_dev)))
-                   for slots, toks_dev, steps_dev in launches]
+            self._note_dispatch_locked(dispatch_t)
+            seq = self._launch_seq
+        self._dispatch_s.append(time.monotonic() - dispatch_t)
         if self._tracer.enabled:
             self._tracer.add_span(
-                "iteration", cat="serve", tid=0,
-                start=iter_start, end=time.monotonic(),
+                "dispatch", cat="serve", tid=0,
+                start=dispatch_t, end=time.monotonic(),
                 args={"active_slots": len(active_slots),
                       "generations": len(by_gen), "megastep": K})
-        step_done = time.monotonic()
+        return _InflightMegastep(
+            launches=launches, decoding=decoding,
+            base_len={s: len(decoding[s].tokens) + prev_pending.get(s, 0)
+                      for s in active_slots},
+            pending=pending, steps=K, dispatch_t=dispatch_t, seq=seq,
+            clock_dev=clock)
+
+    def _megastep_fetch(self, rec: _InflightMegastep) -> None:
+        """Fetch half: resolve a dispatched megastep — ONE (num_slots, K)
+        fetch per launch — then trim, stamp TPOT, and retire at the
+        boundary.
+
+        The host trims each row's fetched tokens with the same
+        ``req.done()`` walk that retires it, so a row finishing at inner
+        step j < K contributes exactly its first j+1 tokens —
+        bit-identical to the K=1 path — and nothing after its eos leaks
+        into ``req.tokens``.  A slot that retired at a PREVIOUS fetch
+        (its eos was in flight when this launch dispatched) is skipped
+        whole: its columns here are the zombie tail the donation fence
+        already contains.
+
+        TPOT for K > 1 anchors to the launch's device window via the
+        iteration clock: the realized inner-step cadence is
+        (fetch - dispatch) / steps_run, and a row's j-th fetched token
+        is stamped dispatch + (j+1) cadences — real megastep timing
+        per inner step, not an equal share of the host's observation
+        gap (which, async, includes a whole iteration of host work)."""
+        K = rec.steps
+        fetched = [(slots, self._fetch_host(toks_dev),
+                    int(self._fetch_host(steps_dev)))
+                   for slots, toks_dev, steps_dev in rec.launches]
+        clock_now = int(self._fetch_host(rec.clock_dev))
+        fetch_done = time.monotonic()
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "fetch", cat="serve", tid=0,
+                start=rec.dispatch_t, end=fetch_done,
+                args={"megastep": K, "launches": len(rec.launches)})
+        span = max(fetch_done - rec.dispatch_t, 0.0)
         gaps: List[float] = []
         appended = 0
         effective = 0
         for slots, toks, steps_run in fetched:
             effective += steps_run
+            per_step = span / max(steps_run, 1)
             for slot in slots:
-                req = decoding[slot]
+                req = rec.decoding[slot]
+                if req.finished_at is not None:
+                    continue  # retired at an earlier fetch: zombie tail
                 n = 0
                 for j in range(K):
                     if req.done():
                         break  # trim the dead row's tail columns
                     req.tokens.append(int(toks[slot, j]))
                     n += 1
+                    t_emit = rec.dispatch_t + (j + 1) * per_step
+                    if req.last_token_at is not None:
+                        gaps.append(
+                            max(t_emit - req.last_token_at, 0.0) * 1e3)
+                    req.last_token_at = t_emit
                 appended += n
-                self._last_tok[slot, 0] = req.tokens[-1]
-                if n and req.last_token_at is not None:
-                    per = (step_done - req.last_token_at) * 1000.0 / n
-                    gaps.extend([per] * n)
-                req.last_token_at = step_done
+                if n:
+                    self._last_tok[slot, 0] = req.tokens[-1]
                 if req.done():
                     self._retire(req)
+        self._step_s.append(span / max(effective, 1))
         with self._lock:
+            self._device_clock = clock_now
             self._tpot_gaps_ms.extend(gaps)
-            self._megastep_launches += len(launches)
+            self._megastep_launches += len(rec.launches)
             self._megastep_tokens += appended
             self._megastep_effective_steps += effective
-            for _ in launches:
+            for _ in rec.launches:
                 self._obs["megastep_size"].observe(K)
-            saved = appended - len(launches)
+            saved = appended - len(rec.launches)
             if saved > 0:
                 self._obs["megastep_amortized"].inc(saved)
+            self._note_fetch_done_locked(rec.seq, fetch_done)
+            self._obs["device_idle"].set(self._idle_fraction_locked())
+
+    def _fetch_host(self, value):
+        """THE host-fetch point for launch outputs: one explicit
+        ``jax.device_get`` — already an ndarray, no extra ``np.asarray``
+        round-trip — so every host sync in the hot loop routes through
+        a single sanctioned helper."""
+        return jax.device_get(value)
+
+    def _flush_inflight(self) -> None:
+        """Resolve the in-flight launch, if any.  The barrier for every
+        path that needs the host view current: mode switches back to
+        sync, autotune re-picking K, drain, and loop exit."""
+        rec, self._inflight = self._inflight, None
+        if rec is not None:
+            self._megastep_fetch(rec)
+
+    def _needs_sync(self) -> bool:
+        """Rows the double-buffered dispatch cannot serve from a
+        one-iteration-stale host view: speculative decoding drafts from
+        ``req.tokens`` (incomplete while in flight), multiple live
+        generations chain grouped launches (the fetch order would
+        interleave with the next dispatch), and SEEDED sampling folds
+        ``len(req.tokens)`` into its per-row key (a stale step would
+        replay keys).  Greedy rows ignore the RNG entirely and unseeded
+        sampled rows draw from the global per-launch counter — fresh
+        every dispatch — so both stay async-safe."""
+        if self.spec_k:
+            return True
+        with self._lock:
+            reqs = [r for r in self._active.values() if r.tokens]
+        gens = set()
+        for req in reqs:
+            if req.sampling is not None and req.sampling.seed is not None:
+                return True
+            gens.add(req.gen.generation)
+        return len(gens) > 1
+
+    def _note_dispatch_locked(self, t: float) -> None:
+        """Device-idle accounting at dispatch: close the open
+        fetch-to-dispatch gap (time the device sat with no launch in
+        flight) and advance the launch sequence."""
+        if self._window_start is None:
+            self._window_start = t
+        if self._await_gap_from is not None:
+            self._idle_gap_s += max(0.0, t - self._await_gap_from)
+            self._await_gap_from = None
+        self._launch_seq += 1
+
+    def _note_fetch_done_locked(self, seq: int, t: float) -> None:
+        """Device-idle accounting at fetch: when NO newer launch was
+        dispatched after this one (sync mode, or an async drain), the
+        device idles from here until the next dispatch — open the gap.
+        Async steady state dispatches N+1 before fetching N, so the
+        sequence check keeps the gap closed."""
+        self._window_end = t
+        if self._launch_seq == seq:
+            self._await_gap_from = t
+
+    def _idle_fraction_locked(self) -> float:
+        """Idle gap time over the first-dispatch .. last-fetch window."""
+        if self._window_start is None or self._window_end is None:
+            return 0.0
+        window = self._window_end - self._window_start
+        if window <= 0.0:
+            return 0.0
+        return min(1.0, self._idle_gap_s / window)
+
+    def _autotune_eval(self) -> None:
+        """One autotune control step (``megastep='auto'``): pick K from
+        the measured host-dispatch vs device-step times, then FREEZE.
+
+        The dispatch cost ``a`` amortizes over K inner device steps of
+        ``b`` seconds each; K is the smallest power of two keeping the
+        host half under half the device window (a <= K*b/2, i.e.
+        K >= 2a/b), clamped to [1, _AUTOTUNE_MAX_K].  Powers of two
+        keep the compiled-program set tiny and the pick stable under
+        timing noise; freezing at the first confident pick guarantees
+        no steady-state recompiles."""
+        if (len(self._dispatch_s) < _AUTOTUNE_MIN_SAMPLES
+                or len(self._step_s) < _AUTOTUNE_MIN_SAMPLES):
+            return
+        a = sum(self._dispatch_s) / len(self._dispatch_s)
+        b = max(sum(self._step_s) / len(self._step_s), 1e-9)
+        target = 2.0 * a / b
+        k = 1
+        while k < target and k < _AUTOTUNE_MAX_K:
+            k *= 2
+        with self._lock:
+            k_changed = k != self.megastep
+        if k_changed:
+            self._flush_inflight()  # the old-K launch resolves first
+        with self._lock:
+            self._autotune_frozen = True
+            self.megastep = k
+        logger.info(
+            "megastep autotune: froze K=%d (dispatch %.3f ms, inner "
+            "step %.3f ms)", k, a * 1e3, b * 1e3)
 
     def _draft_for(self, req: _SlotRequest) -> Optional[np.ndarray]:
         """n-gram prompt-lookup drafter: match the request's last n tokens
@@ -1605,9 +2001,13 @@ class ContinuousScheduler:
             self._iterations += 1
             self._occupancy_sum += len(active_slots)
             self._last_occupancy = len(active_slots)
-        fetched = [(slots, np.asarray(jax.device_get(targets_dev)),
-                    np.asarray(jax.device_get(accepted_dev)))
+            self._note_dispatch_locked(iter_start)
+            spec_seq = self._launch_seq
+        fetched = [(slots, self._fetch_host(targets_dev),
+                    self._fetch_host(accepted_dev))
                    for slots, targets_dev, accepted_dev in launches]
+        with self._lock:
+            self._note_fetch_done_locked(spec_seq, time.monotonic())
         if self._tracer.enabled:
             self._tracer.add_span(
                 "iteration", cat="serve", tid=0,

@@ -109,8 +109,16 @@ class ServeArgs:
     # (num_slots, K) fetch per K tokens.  Rows hitting their eos/horizon
     # mid-megastep stop advancing on device and are trimmed on host, so
     # greedy output is bit-identical K on vs off.  1 = classic
-    # one-launch-per-token path.
-    megastep: int = 1
+    # one-launch-per-token path.  "auto" probes the dispatch-vs-step
+    # time ratio on a throwaway scheduler BEFORE the timed run and pins
+    # the chosen K for the run itself, so compiled-program identity
+    # stays stable (no post-warmup recompiles).
+    megastep: Any = 1
+    # Async double-buffered decode: dispatch megastep N+1 before
+    # fetching megastep N's tokens, so admission/prefill/retirement run
+    # while the device computes.  Costs one iteration of admission lag;
+    # greedy output stays bit-identical on vs off.
+    async_decode: bool = False
     # Speculative decoding: k >= 1 turns each decode iteration into
     # draft-and-verify — an n-gram prompt-lookup drafter (no second
     # model) proposes up to k tokens per slot from the slot's own
@@ -335,6 +343,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **_cache_kwargs(args),
@@ -394,6 +403,7 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             name=f"serve-fleet-r{i}",
@@ -408,6 +418,59 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             CheckpointManager(args.checkpoint_dir), replicas,
             poll_interval_s=args.reload_poll_s, owns_manager=True)
     return FleetRouter(replicas, watcher=watcher)
+
+
+def _resolve_megastep(args: ServeArgs, engine: ServeEngine,
+                      payloads) -> int:
+    """Resolve ``--megastep=auto`` to a concrete K before the timed run.
+
+    A throwaway scheduler runs with ``megastep="auto"`` on the SAME
+    engine and replays the run's own traffic until the autotuner has
+    enough dispatch/step timing samples to freeze its pick.  The timed
+    run (and its ``_warm`` pass) then gets the frozen K as a plain int,
+    so every program the run launches compiles during warmup and
+    compiled-program identity stays stable — ``compile_post_warmup``
+    must not move because K was chosen dynamically."""
+    if args.megastep != "auto":
+        return int(args.megastep)
+    if args.model != "gpt2" or not args.continuous:
+        raise ValueError(
+            "--megastep=auto autotunes the continuous gpt2 decode loop "
+            "(--continuous); fixed-batch decode has no megastep")
+    cfg = engine.module.cfg
+    need = max(p.shape[0] + m for p, m in map(_payload_parts, payloads))
+    warm_kwargs = {**_cache_kwargs(args), "prefix_cache": False} \
+        if args.cache_mode == "paged" else _cache_kwargs(args)
+    probe = ContinuousScheduler(
+        engine,
+        num_slots=args.num_slots,
+        max_total_len=min(cfg.n_positions, need),
+        temperature=args.temperature,
+        top_k=args.top_k,
+        prefill_budget=args.prefill_budget,
+        megastep="auto",
+        async_decode=args.async_decode,
+        spec_k=args.spec_k or None,
+        spec_ngram=args.spec_ngram,
+        **warm_kwargs,
+    )
+    try:
+        deadline = time.monotonic() + 120.0
+        i = 0
+        while (not probe.stats()["megastep_autotune_frozen"]
+               and time.monotonic() < deadline):
+            batch = []
+            for _ in range(max(2, args.num_slots)):
+                p, m = _payload_parts(payloads[i % len(payloads)])
+                batch.append(probe.submit(p, max_new_tokens=m))
+                i += 1
+            for f in batch:
+                f.result(timeout=600.0)
+        k = int(probe.stats()["megastep"])
+    finally:
+        probe.close()
+    logger.info("megastep=auto resolved to K=%d before the timed run", k)
+    return k
 
 
 def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
@@ -433,6 +496,9 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
         # budget-size chunks plus its ragged final chunk.
         # Same megastep too: the K-step scan is its own compiled program
         # (keyed on K), so the timed run must not pay its compile.
+        # Same async_decode: the double-buffered loop routes EVERY K
+        # (including K=1) through the megastep program, so the warm
+        # traffic must walk the same dispatch path the timed run will.
         warm_sched = ContinuousScheduler(
             engine, num_slots=args.num_slots,
             max_total_len=min(engine.module.cfg.n_positions,
@@ -441,6 +507,7 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
             temperature=args.temperature, top_k=args.top_k,
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
+            async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **warm_kwargs)
@@ -482,6 +549,13 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             "programs' runtime vectors")
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
+    megastep_auto = args.megastep == "auto"
+    if megastep_auto:
+        # Resolve BEFORE warm/batcher construction: the warm pass then
+        # compiles the chosen K's programs, and the timed run never
+        # sees a dynamic K.
+        args = dataclasses.replace(
+            args, megastep=_resolve_megastep(args, engine, payloads))
     is_lm = args.model == "gpt2"
     fleet = is_lm and args.continuous and args.num_replicas > 1
     if args.num_replicas > 1 and not fleet:
@@ -614,8 +688,12 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["prefill_budget"] = int(args.prefill_budget)
         out["prefill_chunks"] = int(stats.get("prefill_chunks", 0.0))
         out["megastep"] = int(args.megastep)
+        out["megastep_auto"] = megastep_auto
         out["megastep_launches"] = int(stats.get("megastep_launches", 0.0))
         out["megastep_tokens"] = int(stats.get("megastep_tokens", 0.0))
+        out["async_decode"] = bool(args.async_decode)
+        out["device_idle_fraction"] = round(
+            stats.get("device_idle_fraction", 0.0), 4)
         out["spec_k"] = int(args.spec_k)
         if args.spec_k:
             out["spec_launches"] = int(stats.get("spec_launches", 0.0))

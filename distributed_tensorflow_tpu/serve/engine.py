@@ -854,7 +854,7 @@ class ServeEngine:
 
     def _megastep_apply(self, steps, paged, params, cache, counts, tokens,
                         active, horizon, eos_rows, block_tables, rng,
-                        counter, sampling):
+                        counter, sampling, fresh_tokens, fresh, clock):
         """K fused decode iterations as ONE program: a bounded
         ``lax.while_loop`` over the inner step with the whole per-slot
         decode state in the carry, exiting EARLY once every row is dead
@@ -876,9 +876,20 @@ class ServeEngine:
         sizes too.  The executed-step count rides out as a device
         scalar (``steps_run``) so the scheduler can account the saved
         iterations.
+
+        ASYNC DISPATCH SUPPORT: ``fresh`` (num_slots,) bool marks rows
+        whose true last token lives in the HOST vector ``fresh_tokens``
+        (a row prefilled while a previous megastep was still in flight,
+        so its entry in the device carry is stale); the input token is
+        ``where(fresh, fresh_tokens, tokens)`` resolved ON DEVICE.
+        ``clock`` is the on-device iteration counter chained
+        launch-to-launch; it advances by the EXECUTED inner steps, so
+        the host can pin the clock-chaining invariant without a
+        synchronous readback between launches.
         """
         num_slots = tokens.shape[0]
         slots = jnp.arange(num_slots, dtype=jnp.int32)
+        tok0 = jnp.where(fresh, fresh_tokens, tokens)
 
         def _body(state):
             j, cache, counts, tok, alive, left, toks = state
@@ -919,18 +930,20 @@ class ServeEngine:
             j, _, _, _, alive, _, _ = state
             return (j < steps) & jnp.any(alive)
 
-        init = (jnp.int32(0), cache, counts, tokens, active & (horizon > 0),
+        init = (jnp.int32(0), cache, counts, tok0, active & (horizon > 0),
                 horizon, jnp.zeros((num_slots, steps), jnp.int32))
         steps_run, cache, counts, tok_final, _, _, toks = jax.lax.while_loop(
             _cond, _body, init)
-        return toks, tok_final, steps_run, cache, counts
+        clock_out = clock + steps_run
+        return toks, tok_final, steps_run, clock_out, cache, counts
 
     def decode_megastep(self, cache: PyTree, last_tokens, active: np.ndarray,
                         horizon: np.ndarray, *, steps: int,
                         eos_rows=None, temperature: float = 0.0,
                         top_k: int = 0, sampling=None, counts=None,
                         rng=None, counter: int = 0,
-                        paged=None, block_tables=None, params=None):
+                        paged=None, block_tables=None, params=None,
+                        fresh_tokens=None, fresh=None, clock=None):
         """K decode iterations in ONE compiled program (a bounded
         ``lax.while_loop`` over the step).  Returns (tokens
         (num_slots, K), final token (num_slots,), executed inner steps
@@ -964,8 +977,16 @@ class ServeEngine:
         counts updated by the earlier inner steps, and seeded rows fold
         ``step + j`` into their private key — so penalties and seeded
         streams are reproducible across megastep sizes.  With ``counts``
-        the return grows to (tokens, final token, steps_run, cache,
-        counts); without it the legacy 4-tuple holds."""
+        the return grows to (tokens, final token, steps_run, clock_out,
+        cache, counts); without it the legacy 4-tuple holds.
+
+        ASYNC DISPATCH: ``fresh``/``fresh_tokens`` resolve rows whose
+        device-carried token went stale while a launch was in flight
+        (the input token becomes ``where(fresh, fresh_tokens,
+        last_tokens)`` on device), and ``clock`` chains the on-device
+        iteration counter — pass the previous launch's ``clock_out``
+        handle to keep the chain pure device-side.  All three default
+        to no-ops (no fresh rows, clock 0)."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         steps = int(steps)
@@ -986,6 +1007,14 @@ class ServeEngine:
         n = len(active)
         eos = (np.full((n,), -1, np.int32) if eos_rows is None
                else np.asarray(eos_rows, np.int32))
+        if fresh_tokens is None:
+            fresh_tokens = np.zeros((n,), np.int32)
+        elif not isinstance(fresh_tokens, jax.Array):
+            fresh_tokens = np.asarray(fresh_tokens, np.int32).reshape(-1)
+        fresh = (np.zeros((n,), bool) if fresh is None
+                 else np.asarray(fresh, bool))
+        if clock is None:
+            clock = np.int32(0)
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
@@ -998,16 +1027,16 @@ class ServeEngine:
                 tokens_dev = jax.device_put(
                     np.asarray(tokens_dev, np.int32).reshape(-1),
                     batch_sharding(self.mesh))
-            toks, tok_final, steps_run, cache, counts = (
+            toks, tok_final, steps_run, clock_out, cache, counts = (
                 self._generate_fns[key](
                     self.params if params is None else params, cache, counts,
                     tokens_dev, np.asarray(active, bool),
                     np.asarray(horizon, np.int32), eos, bt, base, counter,
-                    sampling))
+                    sampling, fresh_tokens, fresh, clock))
         self._obs["megastep"].observe(time.perf_counter() - t0)
         if legacy:
             return toks, tok_final, steps_run, cache
-        return toks, tok_final, steps_run, cache, counts
+        return toks, tok_final, steps_run, clock_out, cache, counts
 
     def _verify_slots_apply(self, k, paged, params, cache, counts, tokens,
                             active, draft_lens, block_tables, rng, counter,

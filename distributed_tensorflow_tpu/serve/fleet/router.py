@@ -79,6 +79,13 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     full acceptance, none at zero), so an idle-drafter replica ranks
     exactly like a spec-off one and homogeneous fleets keep identical
     rankings.
+
+    Async double-buffered decode keeps ONE extra megastep in flight: a
+    queued request admitted now still waits out the launch already on
+    the device before its first decode, so the boundary term sees an
+    effective depth of one additional megastep.  Same 2x saturation,
+    and homogeneous fleets (all-async or all-sync) keep identical
+    rankings.
     """
     depth = stats.get("queue_depth", 0.0)
     cap = max(1.0, stats.get("capacity", 1.0))
@@ -89,6 +96,8 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     free = stats.get("blocks_free", 0.0)
     kv_pressure = (1.0 - free / total) if total else 0.0
     mega = max(1.0, stats.get("megastep", 1.0))
+    if stats.get("async_decode", 0.0):
+        mega *= 2.0  # one extra megastep always in flight
     boundary_scale = min(2.0, 1.0 + (mega - 1.0) / 8.0)
     spec_scale = 1.0
     if stats.get("spec_k", 0.0):
@@ -254,6 +263,7 @@ class FleetRouter:
         "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
         "param_generation", "prefill_budget", "megastep", "spec_k",
+        "async_decode", "device_idle_fraction",
     )
 
     def stats(self) -> Dict[str, float]:

@@ -21,6 +21,8 @@ Examples:
         --prompt_lens=8,8,8,512       # chunked prefill under whale prompts
     python serve.py --model=gpt2 --continuous --megastep=8 \
         --max_new_tokens=32           # K fused decode steps per dispatch
+    python serve.py --model=gpt2 --continuous --async_decode \
+        --megastep=auto               # double-buffered loop, autotuned K
     python serve.py --model=gpt2 --continuous --spec_k=4 \
         --prompt_period=4             # speculative decode, repetitive mix
     python serve.py --model=gpt2 --continuous \
@@ -44,6 +46,17 @@ import signal
 import threading
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+
+def _megastep_arg(value):
+    # int K, or the literal "auto" (autotune K before the timed run).
+    if value == "auto":
+        return value
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--megastep takes an int >= 1 or 'auto', got {value!r}")
 
 
 def parse_args(argv=None):
@@ -126,13 +139,23 @@ def parse_args(argv=None):
                         "decoding slots keep stepping, so decode TPOT "
                         "never stalls behind a whale prompt; greedy "
                         "output is bit-identical (0 = one-shot prefill)")
-    p.add_argument("--megastep", type=int, default=defaults.megastep,
+    p.add_argument("--megastep", type=_megastep_arg,
+                   default=defaults.megastep,
                    help="continuous mode: fuse this many decode iterations "
                         "into ONE compiled program (on-device lax.scan) — "
                         "one host dispatch + one fetch per K tokens; rows "
                         "finishing mid-megastep stop on device and trim on "
                         "host, so greedy output is bit-identical to "
-                        "--megastep=1 (the classic per-token launch)")
+                        "--megastep=1 (the classic per-token launch); "
+                        "'auto' probes the dispatch/step-time ratio before "
+                        "the timed run and pins the chosen K")
+    p.add_argument("--async_decode", action="store_true",
+                   default=defaults.async_decode,
+                   help="continuous mode: double-buffer the decode loop — "
+                        "dispatch megastep N+1 before fetching megastep "
+                        "N's tokens, overlapping host scheduling with "
+                        "device compute (one iteration of admission lag; "
+                        "greedy output is bit-identical on vs off)")
     p.add_argument("--spec_k", type=int, default=defaults.spec_k,
                    help="continuous mode: speculative decoding — an "
                         "n-gram prompt-lookup drafter (no second model) "

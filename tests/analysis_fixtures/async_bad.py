@@ -1,0 +1,50 @@
+"""Seeded async double-buffering violations: the dispatch half launches
+megastep N+1 through the donated resident cache while megastep N is
+still in flight — reading the pre-launch cache handle after dispatch
+(``use-after-donate``, through a local pin and through ``self._cache``)
+and ``float()``-ing the still-in-flight token array instead of waiting
+for the fetch half (``host-sync``, a stall that serializes the overlap
+the double buffer exists for).  Each rule must flag exactly its marked
+lines."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_launch_lock = threading.Lock()
+
+
+class MiniAsyncEngine:
+    def __init__(self, module, params, cache):
+        self.module = module
+        self.params = params
+        self._cache = cache
+        self._pending = None
+        self._step = jax.jit(self._decode_apply, donate_argnums=(1,))
+
+    def _decode_apply(self, params, cache, tok):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def decode(self, tok, steps):
+        # Double-buffered loop done WRONG: the pre-launch cache handle
+        # is pinned in a local, donated to the dispatch, then read —
+        # its buffer now belongs to the in-flight launch.
+        for _ in range(steps):
+            cache = self._cache
+            with _launch_lock:
+                tok, self._cache = self._step(self.params, cache, tok)
+            probe = jnp.sum(cache)  # SEED: use-after-donate
+            if float(tok[0]) == 0:  # SEED: host-sync
+                break
+        return probe
+
+    def drain(self, tok):
+        # The drain launch donates self._cache but binds the result
+        # elsewhere — the attribute still names the dead buffer.
+        with _launch_lock:
+            tok, fresh = self._step(self.params, self._cache, tok)
+        self._pending = fresh
+        return jnp.sum(self._cache)  # SEED: use-after-donate

@@ -48,6 +48,8 @@ CASES = [
     ("donate", UseAfterDonateRule, "use-after-donate"),
     ("donate", DonationDisciplineRule, "donation-discipline"),
     ("hostsync", HostSyncRule, "host-sync"),
+    ("async", UseAfterDonateRule, "use-after-donate"),
+    ("async", HostSyncRule, "host-sync"),
 ]
 
 

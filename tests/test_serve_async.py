@@ -1,0 +1,325 @@
+"""Async double-buffered decode tests: dispatching megastep N+1 before
+fetching megastep N's tokens must be a pure SCHEDULING change — greedy
+output is bit-identical async on vs off, dense and paged, on both
+acceptance meshes, composed with megastep, chunked prefill, the prefix
+cache, speculative decoding and mid-stream hot reload — while the one
+semantic it does change is pinned explicitly: a request submitted while
+megastep N is in flight decodes no token before iteration N+2 (one
+iteration of admission lag buys the overlap).
+
+``--megastep=auto`` rides the same loop: the autotuner picks K from the
+observed dispatch-vs-step-time ratio and FREEZES, so compiled-program
+identity stays stable; the control law is pinned against a stubbed
+timing source (no real clocks in the assert path).
+
+The ctor-validation and stubbed-autotune tests never launch a decode
+program and run in tier-1; everything that compiles end-to-end decode
+carries ``serve_slow`` (excluded from tier-1 alongside ``slow``)."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.serve import ContinuousScheduler, ServeEngine
+
+
+def _mixed_requests(vocab, seed=3):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i, length in enumerate((4, 6, 9, 8, 17, 5)):
+        horizon = (2, 5, 3, 4)[i % 4]
+        reqs.append((rng.integers(0, vocab, size=(length,), dtype=np.int32),
+                     horizon))
+    return reqs
+
+
+def _fixed_reference(engine, prompt, max_new_tokens):
+    rows = engine.bucket_rows(1)
+    out = engine.generate(np.repeat(prompt[None, :], rows, axis=0),
+                          max_new_tokens)
+    return out[0]
+
+
+def _run_all(sched, reqs):
+    futs = [sched.submit(p, max_new_tokens=m) for p, m in reqs]
+    return [f.result(timeout=300) for f in futs]
+
+
+@pytest.fixture(scope="module")
+def gpt2_engine(request):
+    mesh_dp = request.getfixturevalue("mesh_dp")
+    eng = ServeEngine("gpt2", mesh=mesh_dp, preset="tiny")
+    yield eng
+    eng.close()
+
+
+class TestCtorValidation:
+    def test_bogus_megastep_string_rejected(self, gpt2_engine):
+        with pytest.raises(ValueError, match="megastep"):
+            ContinuousScheduler(gpt2_engine, megastep="fast", start=False)
+
+    def test_auto_megastep_with_spec_rejected(self, gpt2_engine):
+        with pytest.raises(ValueError, match="auto"):
+            ContinuousScheduler(gpt2_engine, megastep="auto", spec_k=2,
+                                start=False)
+
+    def test_stats_export_async_keys(self, gpt2_engine):
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, megastep="auto",
+                                    async_decode=True, start=False)
+        stats = sched.stats()
+        assert stats["async_decode"] == 1.0
+        assert stats["megastep_auto"] == 1.0
+        assert stats["megastep_autotune_frozen"] == 0.0
+        assert stats["megastep"] == 1.0  # autotune starts at the classic K
+        assert stats["device_clock"] == 0.0
+        assert stats["device_idle_fraction"] == 0.0
+        sched.close(timeout=0.1)
+
+
+@pytest.mark.serve_slow
+class TestAsyncParity:
+    """Greedy output must be bit-identical async on vs off: the double
+    buffer changes WHEN tokens land on host, never what any row
+    decodes."""
+
+    # One K per cache mode keeps the compile surface affordable while
+    # covering both regimes: K=3 forces carry chains across launches
+    # (ragged vs every horizon), K=8 swallows whole horizons in one
+    # launch — both must survive an extra launch always in flight.
+    @pytest.mark.parametrize("cache_mode,steps", [("dense", 3),
+                                                  ("paged", 8)])
+    def test_async_on_off_token_identical(self, gpt2_engine, cache_mode,
+                                          steps):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab)
+        kwargs = dict(num_slots=8, max_total_len=32)
+        if cache_mode == "paged":
+            kwargs.update(cache_mode="paged", block_size=4)
+        with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
+            baseline = _run_all(sched, reqs)
+        with ContinuousScheduler(gpt2_engine, megastep=steps,
+                                 async_decode=True, **kwargs) as sched:
+            overlapped = _run_all(sched, reqs)
+            stats = sched.stats()
+            assert stats["async_decode"] == 1.0
+            assert stats["megastep_launches"] > 0
+        for (prompt, horizon), base, out in zip(reqs, baseline,
+                                                overlapped):
+            np.testing.assert_array_equal(out, base)
+            np.testing.assert_array_equal(
+                out, _fixed_reference(gpt2_engine, prompt, horizon))
+
+    def test_parity_on_2d_mesh(self, mesh_2d):
+        """data=4 x tensor=2, paged (the harder case: device-resident
+        block tables ride the in-flight launch): the sharded outputs
+        chain into the next dispatch without a host round-trip."""
+        with ServeEngine("gpt2", mesh=mesh_2d, preset="tiny") as eng:
+            vocab = eng.module.cfg.vocab_size
+            reqs = _mixed_requests(vocab, seed=5)
+            kwargs = dict(num_slots=8, max_total_len=32,
+                          cache_mode="paged", block_size=4)
+            with ContinuousScheduler(eng, **kwargs) as sched:
+                baseline = _run_all(sched, reqs)
+            with ContinuousScheduler(eng, megastep=4, async_decode=True,
+                                     **kwargs) as sched:
+                overlapped = _run_all(sched, reqs)
+            for base, out in zip(baseline, overlapped):
+                np.testing.assert_array_equal(out, base)
+
+
+@pytest.mark.serve_slow
+class TestAsyncComposition:
+    def test_chunked_prefill_composes(self, gpt2_engine):
+        """Chunked prefill admits mid-flight rows whose true last token
+        lives on host while a launch is in flight — the fresh-token
+        device merge must keep them bit-identical."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, seed=7)
+        kwargs = dict(num_slots=8, max_total_len=32)
+        with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
+            baseline = _run_all(sched, reqs)
+        with ContinuousScheduler(gpt2_engine, prefill_budget=4, megastep=4,
+                                 async_decode=True, **kwargs) as sched:
+            stacked = _run_all(sched, reqs)
+            assert sched.stats()["prefill_chunks"] > len(reqs)
+        for base, out in zip(baseline, stacked):
+            np.testing.assert_array_equal(out, base)
+
+    def test_prefix_cache_composes(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(13)
+        prefix = rng.integers(0, vocab, size=(8,), dtype=np.int32)
+        reqs = [(np.concatenate([prefix, rng.integers(
+                     0, vocab, size=(n,), dtype=np.int32)]), 3)
+                for n in (4, 6, 9)]
+        kwargs = dict(num_slots=8, max_total_len=32, cache_mode="paged",
+                      block_size=4, prefix_cache=True)
+        runs = []
+        for async_on in (False, True):
+            with ContinuousScheduler(gpt2_engine, megastep=8,
+                                     async_decode=async_on,
+                                     **kwargs) as sched:
+                outs = [sched.submit(p, max_new_tokens=m).result(timeout=300)
+                        for p, m in reqs]
+                stats = sched.stats()
+                runs.append((outs, stats["prefill_tokens_skipped"],
+                             stats["prefix_hits"]))
+        (base_outs, base_skip, base_hits), (outs, skip, hits) = runs
+        assert skip == base_skip > 0
+        assert hits == base_hits > 0
+        for base, out in zip(base_outs, outs):
+            np.testing.assert_array_equal(out, base)
+
+    def test_spec_decoding_composes(self, gpt2_engine):
+        """Per-request draft lengths need the sync spec path; an
+        async_decode scheduler must fall back to it transparently and
+        stay bit-identical."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, seed=11)
+        kwargs = dict(num_slots=8, max_total_len=32)
+        with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
+            baseline = _run_all(sched, reqs)
+        with ContinuousScheduler(gpt2_engine, spec_k=2, async_decode=True,
+                                 **kwargs) as sched:
+            specced = _run_all(sched, reqs)
+        for base, out in zip(baseline, specced):
+            np.testing.assert_array_equal(out, base)
+
+    def test_reload_pins_admission_generation(self, gpt2_engine):
+        """Weights staged while a launch is in flight must not touch the
+        in-flight request: it decodes every remaining launch on the
+        generation pinned at admission, and the reload lands for the
+        NEXT admission."""
+        import time
+
+        vocab = gpt2_engine.module.cfg.vocab_size
+        whale = (np.arange(64, dtype=np.int32) * 3) % vocab
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=96,
+                                 prefill_budget=2, megastep=4,
+                                 async_decode=True) as sched:
+            gen0 = sched.generation
+            fut = sched.submit(whale, max_new_tokens=6)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                s = sched.stats()
+                if s["prefilling_slots"] >= 1.0 and s["prefill_chunks"] >= 1:
+                    break
+                time.sleep(0.001)
+            else:
+                pytest.fail("whale never observed mid-prefill")
+            sched.update_params(gpt2_engine.params, generation=gen0 + 7)
+            out = fut.result(timeout=300)
+            assert fut.generation == gen0
+            post = sched.submit(whale[:4], max_new_tokens=6)
+            post.result(timeout=300)
+            assert post.generation == gen0 + 7
+            assert sched.generation == gen0 + 7
+        np.testing.assert_array_equal(
+            out, _fixed_reference(gpt2_engine, whale, 6))
+
+
+@pytest.mark.serve_slow
+class TestAdmissionLag:
+    """The one semantic async DOES change, pinned by manually stepping
+    the loop: iteration order is host_sched -> dispatch D_N -> fetch
+    D_{N-1}, so a request submitted while megastep N is in flight
+    prefills at N+1, rides launch D_{N+1}, and sees its first decoded
+    tokens only at iteration N+2's fetch."""
+
+    def _trace(self, engine, async_on):
+        vocab = engine.module.cfg.vocab_size
+        rng = np.random.default_rng(21)
+        prompt_a = rng.integers(0, vocab, size=(4,), dtype=np.int32)
+        prompt_b = rng.integers(0, vocab, size=(4,), dtype=np.int32)
+        sched = ContinuousScheduler(engine, num_slots=8, max_total_len=16,
+                                    megastep=4, async_decode=async_on,
+                                    start=False)
+        try:
+            fut_a = sched.submit(prompt_a, max_new_tokens=6)
+            sched._iteration()   # it1: admit+prefill A, dispatch D1
+            fut_b = sched.submit(prompt_b, max_new_tokens=6)  # during D1
+            sched._iteration()   # it2: admit+prefill B, dispatch D2,
+            #                      fetch D1 (sync mode fetches D2 here)
+            with sched._lock:
+                lens = {r.rid: len(r.tokens)
+                        for r in sched._active.values()}
+            b_after_it2 = lens[fut_b.rid]
+            n = 0
+            while not (fut_a.done() and fut_b.done()) and n < 40:
+                sched._iteration()
+                n += 1
+            return (b_after_it2, np.asarray(fut_a.result(timeout=60)),
+                    np.asarray(fut_b.result(timeout=60)))
+        finally:
+            sched.close(timeout=1.0)
+
+    def test_one_iteration_admission_lag(self, gpt2_engine):
+        b_async, out_a, out_b = self._trace(gpt2_engine, True)
+        b_sync, ref_a, ref_b = self._trace(gpt2_engine, False)
+        # Async: after it2, B holds ONLY its prefill token — D2's tokens
+        # are still in flight and land at it3's fetch (N+2).  Sync: it2
+        # fetched D2 before returning, so B already holds 1 + K tokens.
+        assert b_async == 1
+        assert b_sync == 5
+        # The lag re-times delivery; it never changes the tokens.
+        np.testing.assert_array_equal(out_a, ref_a)
+        np.testing.assert_array_equal(out_b, ref_b)
+
+
+class TestAutotune:
+    """The control law, against a stubbed timing source: K is the
+    smallest power of two with dispatch <= K * step / 2, clamped to
+    [1, 32], frozen at the first confident pick."""
+
+    @pytest.mark.parametrize("dispatch_ms,step_ms,expect_k", [
+        (8.0, 1.0, 16),     # 2a/b = 16, exact power of two
+        (3.0, 1.0, 8),      # 2a/b = 6 -> next power of two up
+        (1000.0, 1.0, 32),  # absurd ratio clamps at the ceiling
+        (0.01, 1.0, 1),     # dispatch already cheap: stay classic
+    ])
+    def test_control_law_stubbed(self, gpt2_engine, dispatch_ms, step_ms,
+                                 expect_k):
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, megastep="auto",
+                                    start=False)
+        try:
+            sched._dispatch_s.extend([dispatch_ms / 1e3] * 8)
+            sched._step_s.extend([step_ms / 1e3] * 8)
+            sched._autotune_eval()
+            assert sched.megastep == expect_k
+            assert sched.stats()["megastep_autotune_frozen"] == 1.0
+        finally:
+            sched.close(timeout=0.1)
+
+    def test_too_few_samples_never_freezes(self, gpt2_engine):
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, megastep="auto",
+                                    start=False)
+        try:
+            sched._dispatch_s.extend([0.008] * 7)  # one short of the bar
+            sched._step_s.extend([0.001] * 8)
+            sched._autotune_eval()
+            assert sched.megastep == 1
+            assert sched.stats()["megastep_autotune_frozen"] == 0.0
+        finally:
+            sched.close(timeout=0.1)
+
+    @pytest.mark.serve_slow
+    def test_auto_converges_under_traffic(self, gpt2_engine):
+        """Real traffic: enough iterations to freeze, a K in range, and
+        greedy parity across the mid-stream K switch."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(17)
+        reqs = [(rng.integers(0, vocab, size=(6,), dtype=np.int32), 24)
+                for _ in range(4)]
+        kwargs = dict(num_slots=8, max_total_len=32)
+        with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
+            baseline = _run_all(sched, reqs)
+        with ContinuousScheduler(gpt2_engine, megastep="auto",
+                                 async_decode=True, **kwargs) as sched:
+            tuned = _run_all(sched, reqs)
+            stats = sched.stats()
+            assert stats["megastep_autotune_frozen"] == 1.0
+            assert 1 <= stats["megastep"] <= 32
+        for base, out in zip(baseline, tuned):
+            np.testing.assert_array_equal(out, base)

@@ -22,6 +22,11 @@ Layers:
   ``Replica`` engines by load (queue depth, occupancy, free blocks) and
   ``CheckpointWatcher`` hot-reloads new checkpoint steps without
   dropping in-flight requests;
+- ``gateway``: the HTTP/SSE front door (``GatewayServer``) — per-token
+  streaming through ``submit(on_token=...)`` and bounded
+  ``TokenStream`` queues, client cancellation that frees KV blocks
+  mid-decode, and max-inflight admission control answering 429 +
+  ``Retry-After``;
 - ``obs.ServeMonitorHook`` exports the batcher's/scheduler's counters
   (queue depth, occupancy, TTFT/TPOT).
 """
@@ -38,6 +43,10 @@ from distributed_tensorflow_tpu.serve.fleet import (
     FleetRouter,
     Replica,
 )
+from distributed_tensorflow_tpu.serve.gateway import (
+    GatewayServer,
+    TokenStream,
+)
 from distributed_tensorflow_tpu.serve.paged import (
     BlockAllocator,
     BlockExhaustedError,
@@ -50,10 +59,12 @@ __all__ = [
     "ContinuousScheduler",
     "DynamicBatcher",
     "FleetRouter",
+    "GatewayServer",
     "Replica",
     "ServeArgs",
     "ServeEngine",
     "ServeOverloadedError",
+    "TokenStream",
     "pad_rows",
     "run_serve",
 ]

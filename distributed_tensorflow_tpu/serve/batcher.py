@@ -214,6 +214,17 @@ class DynamicBatcher:
             self._cond.notify()
         return fut
 
+    def cancel(self, rid: int) -> bool:
+        """Cancel one request by its ``rid`` (stamped on the Future by the
+        continuous scheduler at submit).  Iteration-level mode delegates
+        to ``scheduler.cancel`` — queued requests shed before admission,
+        active slots retire at the next iteration boundary and free their
+        KV blocks.  The fixed-batch path has no per-request identity once
+        a batch flushes, so it reports False (not cancellable)."""
+        if self._scheduler is not None:
+            return bool(self._scheduler.cancel(rid))
+        return False
+
     def stats(self) -> Dict[str, float]:
         """Counter snapshot (the ServeMonitorHook export surface).  In
         iteration-level mode this is the scheduler's snapshot — including

@@ -276,6 +276,13 @@ def _continuous_instruments(registry=None):
             "Fraction of the decode window the device sat with NO "
             "launch in flight (gap between a fetch completing and the "
             "next dispatch) — async decode's target"),
+        "ttfb": r.histogram(
+            "dtt_serve_ttfb_seconds",
+            "Submit to first token DELIVERED off the loop thread "
+            "(streaming time-to-first-byte; TTFT plus the emit hop)"),
+        "cancelled": r.counter(
+            "dtt_serve_cancelled_total",
+            "Requests cancelled by the client (queued or mid-decode)"),
     })
     return out
 
@@ -330,6 +337,15 @@ class _SlotRequest:
     # the chunk queue so a whale can't starve behind a stream of new
     # short prompts.
     prefill_idle: int = 0
+    # Streaming: the per-token delivery callback (``submit(on_token=)``),
+    # how many of ``tokens`` have been handed to it, and whether the
+    # client cancelled.  ``cancelled`` is read and written ONLY under the
+    # scheduler lock (set by ``cancel()`` on a client thread, honoured by
+    # the loop at its next iteration boundary); ``streamed`` advances
+    # under the lock too so a cancel can never lose or double a delivery.
+    on_token: Optional[Any] = None
+    streamed: int = 0
+    cancelled: bool = False
 
     def prefilling(self) -> bool:
         return self.next_prefill_offset < len(self.prompt)
@@ -659,6 +675,7 @@ class ContinuousScheduler:
         self._rejected = 0
         self._completed = 0
         self._failed = 0
+        self._cancelled = 0
         self._admitted = 0
         self._retired = 0
         # Prefix caching (under _lock): cacheable-block hit/miss totals
@@ -692,6 +709,7 @@ class ContinuousScheduler:
         self._last_occupancy = 0
         self._latencies_ms: collections.deque = collections.deque(maxlen=1024)
         self._ttft_ms: collections.deque = collections.deque(maxlen=1024)
+        self._ttfb_ms: collections.deque = collections.deque(maxlen=1024)
         self._tpot_ms: collections.deque = collections.deque(maxlen=1024)
         # Individual inter-token gaps (every decoded token's wait, across
         # all requests) — the distribution whose tail chunked prefill
@@ -718,9 +736,21 @@ class ContinuousScheduler:
     def submit(self, prompt: np.ndarray, *,
                max_new_tokens: int = 16,
                eos_token: Optional[int] = None,
-               sampling=None) -> Future:
+               sampling=None,
+               on_token=None) -> Future:
         """Enqueue one prompt; Future resolves to its 1-D token array the
         moment ITS slot retires (out of submission order by design).
+
+        ``on_token`` streams the request: the LOOP thread calls it with
+        each batch of newly fetched tokens (a list of ints — one per
+        iteration at K=1, up to K per megastep, post-trim on the
+        spec/async paths) the moment they land on host.  The callback
+        must be cheap and non-blocking (hand off to a queue — see
+        ``serve.gateway.TokenStream``); it must NOT call back into the
+        scheduler.  A callback that raises is disabled for the rest of
+        the stream (the request itself still completes).  The Future
+        resolves to the SAME full token array either way — streaming is
+        delivery, not a different decode.
 
         ``sampling`` is the request's own config — a
         ``serve.sampling.SamplingParams`` or a kwargs dict for one
@@ -740,6 +770,10 @@ class ContinuousScheduler:
         Raises ``ServeOverloadedError`` when the admission queue is at
         ``max_queue_size`` and ``RuntimeError`` after ``close()``.
         """
+        if on_token is not None and not callable(on_token):
+            raise TypeError(
+                f"on_token must be callable (called with each list of "
+                f"newly decoded tokens), got {type(on_token).__name__}")
         sampling = (self.default_sampling if sampling is None
                     else sampling_lib.coerce(sampling))
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -768,7 +802,7 @@ class ContinuousScheduler:
             prompt=prompt, max_new_tokens=max_new_tokens,
             eos_token=self.eos_token if eos_token is None else eos_token,
             future=Future(), submitted=time.monotonic(),
-            sampling=sampling)
+            sampling=sampling, on_token=on_token)
         if self.prefix_cache:
             # Hash the prompt's full blocks HERE on the client thread —
             # pure compute, so the loop thread only ever walks the map.
@@ -810,6 +844,50 @@ class ContinuousScheduler:
         if isinstance(payload, tuple) and len(payload) == 2:
             return self.submit(payload[0], max_new_tokens=int(payload[1]))
         return self.submit(payload)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel one request by its ``rid`` (stamped on the Future at
+        submit).  Returns True when the request was found live.
+
+        A QUEUED request is removed before admission and its Future
+        cancelled here, synchronously — it never touches a slot.  An
+        ACTIVE request is flagged under the lock and retired by the loop
+        at its next iteration boundary: the slot frees, its KV blocks
+        and reservation release (refcounted prefix shares decrement),
+        and the Future resolves cancelled — ``result()`` raises
+        ``CancelledError``.  Tokens already fetched stay on the Future's
+        request record but nothing further streams: ``on_token``
+        delivery stops the moment the flag is set.  False means the rid
+        is unknown or the request already retired (its Future already
+        carries the full result — cancellation lost the race, which the
+        caller can observe via ``future.done()``)."""
+        queued: Optional[_SlotRequest] = None
+        with self._cond:
+            for i, r in enumerate(self._queue):
+                if r.rid == rid:
+                    queued = r
+                    del self._queue[i]
+                    break
+            if queued is not None:
+                self._cancelled += 1
+                self._obs["cancelled"].inc()
+                self._obs["depth"].set(len(self._queue))
+            else:
+                for r in self._active.values():
+                    if (r.rid == rid and not r.cancelled
+                            and r.finished_at is None):
+                        r.cancelled = True
+                        # Wake the loop: the sweep at the next iteration
+                        # top retires the slot (flushing any in-flight
+                        # async launch first so freed blocks can't take
+                        # a zombie device write).
+                        self._cond.notify_all()
+                        return True
+                return False
+        # Outside the lock: Future callbacks (gateway stream finishers)
+        # run inline on this thread.
+        queued.future.cancel()
+        return True
 
     # -- hot weight reload ----------------------------------------------------
 
@@ -859,8 +937,11 @@ class ContinuousScheduler:
             self._obs["depth"].set(0)
             self._cond.notify_all()
         for req in shed:
-            req.future.set_exception(ServeOverloadedError(
-                "scheduler draining: request shed before admission"))
+            # PENDING -> RUNNING fences out a concurrent client cancel;
+            # False means the cancel already resolved this future.
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(ServeOverloadedError(
+                    "scheduler draining: request shed before admission"))
         with self._cond:
             finished = self._cond.wait_for(
                 lambda: not self._active or self._stopped,
@@ -931,6 +1012,7 @@ class ContinuousScheduler:
                 "completed": float(self._completed),
                 "rejected": float(self._rejected),
                 "failed": float(self._failed),
+                "cancelled": float(self._cancelled),
                 "num_slots": float(self.num_slots),
                 "active_slots": float(len(self._active)),
                 "admitted": float(self._admitted),
@@ -948,6 +1030,11 @@ class ContinuousScheduler:
                 "p99_latency_ms": _percentile(lat, 0.99),
                 "ttft_p50_ms": _percentile(ttft, 0.50),
                 "ttft_p99_ms": _percentile(ttft, 0.99),
+                # Streaming time-to-first-byte: submit -> first token
+                # handed OFF the loop thread (TTFT plus the emit hop) —
+                # what a gateway client actually waits for.
+                "ttfb_p50_ms": _percentile(sorted(self._ttfb_ms), 0.50),
+                "ttfb_p99_ms": _percentile(sorted(self._ttfb_ms), 0.99),
                 "tpot_mean_ms": (sum(tpot) / len(tpot)) if tpot else 0.0,
                 "queue_wait_p50_ms": _percentile(qw, 0.50),
                 "queue_wait_p99_ms": _percentile(qw, 0.99),
@@ -1025,7 +1112,8 @@ class ContinuousScheduler:
             self._active.clear()
             self._free = list(range(self.num_slots))
         for req in leftover:
-            if not req.future.done():
+            if (not req.future.done()
+                    and req.future.set_running_or_notify_cancel()):
                 req.future.set_exception(
                     RuntimeError("ContinuousScheduler closed"))
 
@@ -1053,7 +1141,8 @@ class ContinuousScheduler:
                 self._failed += len(doomed)
                 self._obs["failed"].inc(len(doomed))
             for req in doomed:
-                if not req.future.done():
+                if (not req.future.done()
+                        and req.future.set_running_or_notify_cancel()):
                     req.future.set_exception(e)
 
     def _iteration(self) -> bool:
@@ -1073,50 +1162,8 @@ class ContinuousScheduler:
                    and self._inflight is None):
                 self._cond.wait()
             stopped = self._stopped
-            refill = False
-            if not stopped:
-                if self._pending_gen is not None:
-                    # Install the staged weight generation: every
-                    # admission from here on pins it; rows already
-                    # active keep their own generation's params.
-                    old, self._gen = self._gen, self._pending_gen
-                    self._pending_gen = None
-                    gen_swapped = True
-                    if old.refs == 0:
-                        old.params = None  # nothing in flight holds it
-                    logger.info(
-                        "hot-swapped params: generation %d -> %d "
-                        "(%d request(s) still on the old weights)",
-                        old.generation, self._gen.generation, old.refs)
-                while (self._queue and self._free
-                       and not self._draining):
-                    idx = self._pick_slot_locked(self._queue[0])
-                    if idx is None:
-                        break  # head of line waits on KV blocks
-                    req = self._queue.popleft()
-                    req.slot = self._free.pop(idx)
-                    if self.paged is not None:
-                        # Reserve the worst-case block count now so a
-                        # mid-decode boundary cross can always be
-                        # served — admission is what waits on blocks,
-                        # never a half-decoded stream.
-                        req.reserved_blocks = self.paged.blocks_for(
-                            req.max_written_tokens())
-                        self._reserved[self._slot_shard[req.slot]] += (
-                            req.reserved_blocks)
-                    req.gen = self._gen
-                    self._gen.refs += 1
-                    admits.append(req)
-                if (self.paged is not None and self._queue
-                        and self._free
-                        and self._queue[0].blocked_since is None):
-                    # Head of line is waiting on BLOCKS, not slots:
-                    # start its reservation-wait span.
-                    self._queue[0].blocked_since = time.monotonic()
-                self._obs["depth"].set(len(self._queue))
-                refill = (self.megastep > 1 and bool(admits)
-                          and bool(self._queue) and bool(self._free)
-                          and not self._draining)
+            cancels = ([] if stopped else
+                       [r for r in self._active.values() if r.cancelled])
         if stopped:
             # close() while a launch was in flight: resolve it so its
             # requests' already-computed tokens retire normally instead
@@ -1124,6 +1171,61 @@ class ContinuousScheduler:
             # self._lock, which is not reentrant.
             self._flush_inflight()
             return True
+        if cancels:
+            # Cancel sweep, BEFORE admission so the freed slots (and
+            # their blocks/reservations) are admittable this same
+            # iteration.  A dispatched-but-unfetched async launch may
+            # still be writing a cancelled slot's blocks, so resolve it
+            # first — freed blocks must never take a zombie device
+            # write.  The flush itself retires rows that hit their eos
+            # in flight; ``finished_at`` guards the double retire.
+            self._flush_inflight()
+            for req in cancels:
+                if req.finished_at is None:
+                    self._retire(req)
+        with self._cond:
+            if self._pending_gen is not None:
+                # Install the staged weight generation: every
+                # admission from here on pins it; rows already
+                # active keep their own generation's params.
+                old, self._gen = self._gen, self._pending_gen
+                self._pending_gen = None
+                gen_swapped = True
+                if old.refs == 0:
+                    old.params = None  # nothing in flight holds it
+                logger.info(
+                    "hot-swapped params: generation %d -> %d "
+                    "(%d request(s) still on the old weights)",
+                    old.generation, self._gen.generation, old.refs)
+            while (self._queue and self._free
+                   and not self._draining):
+                idx = self._pick_slot_locked(self._queue[0])
+                if idx is None:
+                    break  # head of line waits on KV blocks
+                req = self._queue.popleft()
+                req.slot = self._free.pop(idx)
+                if self.paged is not None:
+                    # Reserve the worst-case block count now so a
+                    # mid-decode boundary cross can always be
+                    # served — admission is what waits on blocks,
+                    # never a half-decoded stream.
+                    req.reserved_blocks = self.paged.blocks_for(
+                        req.max_written_tokens())
+                    self._reserved[self._slot_shard[req.slot]] += (
+                        req.reserved_blocks)
+                req.gen = self._gen
+                self._gen.refs += 1
+                admits.append(req)
+            if (self.paged is not None and self._queue
+                    and self._free
+                    and self._queue[0].blocked_since is None):
+                # Head of line is waiting on BLOCKS, not slots:
+                # start its reservation-wait span.
+                self._queue[0].blocked_since = time.monotonic()
+            self._obs["depth"].set(len(self._queue))
+            refill = (self.megastep > 1 and bool(admits)
+                      and bool(self._queue) and bool(self._free)
+                      and not self._draining)
         if gen_swapped and self.prefix_cache:
             # Cached K/V is a function of the weights that wrote
             # it: a new generation drops every key (before this
@@ -1409,6 +1511,7 @@ class ContinuousScheduler:
                 else:
                     self._dev_last_tok = None  # host vector is newer
                 self._register_prefix(req)
+                self._emit_tokens(req)
             if self._tracer.enabled:
                 now = time.monotonic()
                 self._tracer.add_span(
@@ -1577,6 +1680,7 @@ class ContinuousScheduler:
             if req.last_token_at is not None:
                 gaps.append((step_done - req.last_token_at) * 1000.0)
             req.last_token_at = step_done
+            self._emit_tokens(req)
             if req.done():
                 self._retire(req)
         with self._lock:
@@ -1772,6 +1876,7 @@ class ContinuousScheduler:
                 appended += n
                 if n:
                     self._last_tok[slot, 0] = req.tokens[-1]
+                    self._emit_tokens(req)
                 if req.done():
                     self._retire(req)
         self._step_s.append(span / max(effective, 1))
@@ -2040,6 +2145,8 @@ class ContinuousScheduler:
                     per = (step_done - req.last_token_at) * 1000.0 / n
                     gaps.extend([per] * n)
                 req.last_token_at = step_done
+                if n:
+                    self._emit_tokens(req)
                 if req.done():
                     self._retire(req)
         drafted_total = int(draft_lens.sum())
@@ -2079,6 +2186,42 @@ class ContinuousScheduler:
             self._decode_counter += count
             return self._decode_counter - count + 1
 
+    def _emit_tokens(self, req: _SlotRequest) -> None:
+        """Deliver ``req``'s not-yet-streamed tokens to its ``on_token``
+        callback (loop thread, right after each host fetch appends them).
+
+        The cancel flag and the streamed high-water mark are read and
+        advanced under the scheduler lock — once ``cancel()`` flips the
+        flag, no further tokens ever reach the callback — but the
+        callback itself runs OUTSIDE the lock: it hands off to a stream
+        queue owned by another thread, and holding the non-reentrant
+        scheduler lock across foreign code invites deadlock.  TTFB is
+        stamped at the first delivery (for every request, streaming or
+        not — the non-streaming TTFB is what a gateway client would have
+        seen)."""
+        with self._lock:
+            if req.cancelled:
+                return
+            new = req.tokens[req.streamed:]
+            if not new:
+                return
+            first = req.streamed == 0
+            req.streamed = len(req.tokens)
+            if first:
+                ttfb_s = time.monotonic() - req.submitted
+                self._ttfb_ms.append(ttfb_s * 1e3)
+                self._obs["ttfb"].observe(ttfb_s)
+            cb = req.on_token
+        if cb is None:
+            return
+        try:
+            cb(list(new))
+        except Exception:  # noqa: BLE001 — stream delivery must not kill decode
+            logger.exception(
+                "on_token callback failed for request %d; disabling "
+                "stream delivery (the request still completes)", req.rid)
+            req.on_token = None
+
     def _retire(self, req: _SlotRequest) -> None:
         req.finished_at = time.monotonic()
         if self._tracer.enabled:
@@ -2107,6 +2250,7 @@ class ContinuousScheduler:
         else:
             used = self.paged_equivalent_blocks
         with self._lock:
+            was_cancelled = req.cancelled
             if self.paged is not None:
                 self._reserved[self._slot_shard[req.slot]] -= (
                     req.reserved_blocks)
@@ -2117,28 +2261,41 @@ class ContinuousScheduler:
                     # Last in-flight request on a superseded generation:
                     # drop the params reference so device buffers free.
                     req.gen.params = None
+            if req.prefilling():
+                # Only a cancelled request retires mid-prefill: give its
+                # unspent prompt tokens back to the backlog gauges.
+                self._prefilling -= 1
+                self._prefill_backlog -= (
+                    len(req.prompt) - req.next_prefill_offset)
+                self._obs["prefilling_slots"].set(self._prefilling)
+                self._obs["prefill_backlog"].set(self._prefill_backlog)
             self._blocks_per_request.append(used)
             self._blocks_hist[used] += 1
             self._active.pop(req.slot, None)
             self._free.append(req.slot)
             self._retired += 1
-            self._completed += 1
             self._obs["retirements"].inc()
-            self._obs["completed"].inc()
             self._obs["active_slots"].set(len(self._active))
-            self._obs["request"].observe(req.finished_at - req.submitted)
-            self._latencies_ms.append(
-                (req.finished_at - req.submitted) * 1e3)
-            if req.first_token_at is not None:
-                self._ttft_ms.append(
-                    (req.first_token_at - req.submitted) * 1e3)
-                if len(req.tokens) > 1:
-                    self._tpot_ms.append(
-                        (req.finished_at - req.first_token_at) * 1e3
-                        / (len(req.tokens) - 1))
-                    self._obs["tpot"].observe(
-                        (req.finished_at - req.first_token_at)
-                        / (len(req.tokens) - 1))
+            if was_cancelled:
+                self._cancelled += 1
+                self._obs["cancelled"].inc()
+            else:
+                self._completed += 1
+                self._obs["completed"].inc()
+                self._obs["request"].observe(
+                    req.finished_at - req.submitted)
+                self._latencies_ms.append(
+                    (req.finished_at - req.submitted) * 1e3)
+                if req.first_token_at is not None:
+                    self._ttft_ms.append(
+                        (req.first_token_at - req.submitted) * 1e3)
+                    if len(req.tokens) > 1:
+                        self._tpot_ms.append(
+                            (req.finished_at - req.first_token_at) * 1e3
+                            / (len(req.tokens) - 1))
+                        self._obs["tpot"].observe(
+                            (req.finished_at - req.first_token_at)
+                            / (len(req.tokens) - 1))
             # Wake drain() waiters when the last resident slot retires.
             self._cond.notify_all()
         if req.gen is not None:
@@ -2147,4 +2304,14 @@ class ContinuousScheduler:
             # stream.  Set BEFORE the result so no waiter observes a
             # resolved future without its tag.
             req.future.generation = req.gen.generation
-        req.future.set_result(np.asarray(req.tokens, np.int32))
+        # These Futures are never RUNNING (no executor), so a client may
+        # legally ``cancel()`` them directly at any moment before the
+        # result lands.  ``set_running_or_notify_cancel`` closes that
+        # window: once it returns True the future is RUNNING and
+        # ``set_result`` cannot be raced; False means a cancel already
+        # won.  A swept cancel resolves the same way — ``result()``
+        # raises ``CancelledError``.
+        if not was_cancelled and req.future.set_running_or_notify_cancel():
+            req.future.set_result(np.asarray(req.tokens, np.int32))
+        else:
+            req.future.cancel()

@@ -168,6 +168,15 @@ class ServeArgs:
     # observability: 0 = no scrape endpoint; >0 binds a Prometheus
     # /metrics HTTP server on that port for the run's lifetime.
     metrics_port: int = 0
+    # streaming gateway (serve/gateway/): 0 = no HTTP front door; >0
+    # binds GatewayServer on that port for the run's lifetime — POST
+    # /v1/generate (SSE per-token streaming with stream=true), POST
+    # /v1/cancel/<gid>, max-inflight admission control.  Requires the
+    # continuous gpt2 path for streaming; non-streaming works anywhere.
+    gateway_port: int = 0
+    # Gateway admission limit: requests in flight beyond this answer
+    # 429 with a Retry-After header instead of queueing unboundedly.
+    max_inflight: int = 64
     # "" = tracing off; a path enables the flight recorder and writes the
     # Chrome trace-event JSON (Perfetto-loadable) there at shutdown.
     trace_out: str = ""
@@ -569,6 +578,16 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
     else:
         _warm(args, engine, payloads)
         batcher = _make_batcher(args, engine)
+    gateway = None
+    if args.gateway_port:
+        from distributed_tensorflow_tpu.serve.gateway import GatewayServer
+
+        # The front door rides the SAME backend the synthetic clients
+        # drive in-process — routing, hot reload, and drain compose.
+        gateway = GatewayServer(batcher, port=args.gateway_port,
+                                max_inflight=args.max_inflight)
+        logger.info("gateway listening on %s:%d (max_inflight=%d)",
+                    gateway.host, gateway.port, args.max_inflight)
     monitor = ServeMonitorHook(batcher, every_steps=args.log_every)
     futures: List[Any] = [None] * len(payloads)
     rejected = [0]
@@ -638,6 +657,10 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         done_payloads = payloads
     elapsed = time.perf_counter() - t0
     stats = batcher.stats()
+    gstats = None
+    if gateway is not None:
+        gstats = gateway.stats()
+        gateway.close(timeout=args.drain_timeout_s)
     batcher.close()
     monitor.log(len(payloads))
 
@@ -685,6 +708,9 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["tpot_mean_ms"] = round(stats["tpot_mean_ms"], 4)
         out["tpot_p50_ms"] = round(stats.get("tpot_p50_ms", 0.0), 4)
         out["tpot_p99_ms"] = round(stats.get("tpot_p99_ms", 0.0), 4)
+        out["cancelled"] = int(stats.get("cancelled", 0.0))
+        out["ttfb_p50_ms"] = round(stats.get("ttfb_p50_ms", 0.0), 3)
+        out["ttfb_p99_ms"] = round(stats.get("ttfb_p99_ms", 0.0), 3)
         out["prefill_budget"] = int(args.prefill_budget)
         out["prefill_chunks"] = int(stats.get("prefill_chunks", 0.0))
         out["megastep"] = int(args.megastep)
@@ -730,6 +756,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["avg_batch_occupancy"] = round(
             stats.get("avg_batch_occupancy", 0.0), 3)
         out["batches"] = int(stats.get("batches", 0))
+    if gstats is not None:
+        out["gateway_port"] = int(args.gateway_port)
+        out["max_inflight"] = int(gstats["gateway_max_inflight"])
+        out["gateway_accepted"] = int(gstats["gateway_accepted"])
+        out["gateway_throttled"] = int(gstats["gateway_throttled"])
+        out["gateway_cancel_requests"] = int(
+            gstats["gateway_cancel_requests"])
+        out["gateway_disconnects"] = int(gstats["gateway_disconnects"])
     if is_lm:
         delivered = int(sum(len(r) for r in results))
         out["tokens_generated"] = delivered

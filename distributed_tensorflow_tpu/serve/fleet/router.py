@@ -246,10 +246,26 @@ class FleetRouter:
     def submit_payload(self, payload):
         return self.submit(payload)
 
+    def cancel(self, rid: int, *, replica: Optional[int] = None) -> bool:
+        """Cancel one request by its scheduler ``rid``.  Rids are
+        per-replica counters — NOT fleet-unique — so callers should pass
+        the ``replica`` attribute the submitted future carries to target
+        the replica that owns the request (the gateway does).  Without a
+        hint every replica is asked in turn; the first that recognises
+        the rid wins, which is only unambiguous on single-replica
+        fleets.  Returns True when some replica cancelled it."""
+        for rep in self.replicas:
+            if replica is not None and rep.replica_id != int(replica):
+                continue
+            if rep.batcher.cancel(rid):
+                return True
+        return False
+
     # -- stats ---------------------------------------------------------------
     _SUM_KEYS = (
         "queue_depth", "capacity", "submitted", "completed", "rejected",
-        "failed", "num_slots", "active_slots", "admitted", "retired",
+        "failed", "cancelled", "num_slots", "active_slots", "admitted",
+        "retired",
         "iterations", "kv_hbm_bytes", "blocks_total", "blocks_free",
         "blocks_in_use", "blocks_high_water", "last_occupancy",
         "prefilling_slots", "prefill_backlog_tokens", "prefill_chunks",
@@ -259,6 +275,7 @@ class FleetRouter:
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
+        "ttfb_p50_ms", "ttfb_p99_ms",
         "tpot_mean_ms", "tpot_p50_ms", "tpot_p99_ms",
         "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",

@@ -1,0 +1,28 @@
+"""Streaming gateway: per-token SSE, cancellation, admission control.
+
+The subsystem has three small parts:
+
+- :mod:`~distributed_tensorflow_tpu.serve.gateway.streams` — the bounded
+  per-request :class:`TokenStream` between the decode loop thread and
+  each HTTP writer thread, plus the shared stream-depth meter.
+- :mod:`~distributed_tensorflow_tpu.serve.gateway.cancel` — the
+  :class:`CancelRegistry` mapping gateway ids to futures, streams, and
+  backend cancel thunks.
+- :mod:`~distributed_tensorflow_tpu.serve.gateway.server` — the stdlib
+  :class:`GatewayServer` (``POST /v1/generate`` with SSE streaming,
+  ``POST /v1/cancel/<gid>``, 429 + ``Retry-After`` admission control).
+"""
+
+from distributed_tensorflow_tpu.serve.gateway.cancel import CancelRegistry
+from distributed_tensorflow_tpu.serve.gateway.server import GatewayServer
+from distributed_tensorflow_tpu.serve.gateway.streams import (
+    DepthMeter,
+    TokenStream,
+)
+
+__all__ = [
+    "CancelRegistry",
+    "DepthMeter",
+    "GatewayServer",
+    "TokenStream",
+]

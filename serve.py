@@ -32,6 +32,8 @@ Examples:
         --trace_out=/tmp/serve_trace.json   # scrape /metrics, dump a trace
     python serve.py --model=gpt2 --continuous --num_replicas=2 \
         --reload_poll_s=5 --checkpoint_dir=/tmp/ckpt  # fleet + hot reload
+    python serve.py --model=gpt2 --continuous --gateway_port=8080 \
+        --max_inflight=32     # HTTP/SSE front door + admission control
 
 SIGTERM (and Ctrl-C) triggers a graceful drain: no new admissions,
 in-flight decodes finish (bounded by --drain_timeout_s), queued requests
@@ -217,6 +219,16 @@ def parse_args(argv=None):
     p.add_argument("--metrics_port", type=int, default=defaults.metrics_port,
                    help="serve a Prometheus /metrics scrape endpoint on "
                         "this port for the run's lifetime (0 = off)")
+    p.add_argument("--gateway_port", type=int, default=defaults.gateway_port,
+                   help="bind the streaming HTTP gateway on this port for "
+                        "the run's lifetime: POST /v1/generate (SSE "
+                        "per-token streaming with stream=true), POST "
+                        "/v1/cancel/<gid>, GET /v1/health|/v1/stats "
+                        "(0 = off)")
+    p.add_argument("--max_inflight", type=int, default=defaults.max_inflight,
+                   help="gateway admission control: requests in flight "
+                        "past this bound are answered 429 + Retry-After "
+                        "instead of queueing unboundedly")
     p.add_argument("--trace_out", default=defaults.trace_out,
                    help="write a Chrome trace-event JSON (per-request "
                         "queue/prefill/decode spans; load in Perfetto) "

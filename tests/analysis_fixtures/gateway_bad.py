@@ -1,0 +1,50 @@
+"""Seeded gateway defects, one per rule family:
+
+- ``StreamFanout`` writes ``pending`` on its pump thread and reads it
+  from the main (HTTP writer) thread with no lock anywhere — the shape
+  of a stream-queue depth counter shared between the decode loop and an
+  SSE writer.  ``cross-thread-race`` must report the write site.
+- ``SseWriter`` turns each decode step's device tokens into SSE payload
+  floats with an implicit fetch (``float(tok[0])``) inside the hot
+  launch loop — the accidental per-token device sync ``host-sync``
+  exists to catch.
+
+Lines are tagged ``# SEED: <rule-id>`` so each rule family only claims
+its own lines when both run over this module.
+"""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class StreamFanout:
+    def __init__(self):
+        self.pending = 0
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while True:
+            self.pending += 1  # SEED: cross-thread-race
+
+    def depth(self) -> int:
+        return self.pending
+
+
+class SseWriter:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, tok: tok)
+
+    def write_stream(self, tok, steps):
+        events = []
+        for _ in range(steps):
+            with _launch_lock:
+                tok = self._step(self.params, tok)
+            events.append(float(tok[0]))  # SEED: host-sync
+        return events

@@ -1,0 +1,44 @@
+"""Clean twin of ``gateway_bad``: the pump-thread write and the
+main-thread read of ``pending`` share one lock, and the SSE payload
+fetch goes through ONE explicit ``jax.device_get`` point per step —
+the sanctioned visible-fetch idiom.  Zero findings expected."""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class StreamFanout:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while True:
+            with self._lock:
+                self.pending += 1
+
+    def depth(self) -> int:
+        with self._lock:
+            return self.pending
+
+
+class SseWriter:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, tok: tok)
+
+    def write_stream(self, tok, steps):
+        events = []
+        for _ in range(steps):
+            with _launch_lock:
+                tok = self._step(self.params, tok)
+            host = jax.device_get(tok)
+            events.append(float(host[0]))
+        return events

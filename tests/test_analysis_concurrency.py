@@ -33,10 +33,19 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 FIXTURES = REPO_ROOT / "tests" / "analysis_fixtures"
 
 
-def seeded_lines(path: Path):
-    """Lines carrying a ``# SEED`` marker — the exact expected findings."""
-    return [i for i, line in enumerate(path.read_text().splitlines(), 1)
-            if "# SEED" in line]
+def seeded_lines(path: Path, rule_id: str | None = None):
+    """Lines carrying a ``# SEED`` marker — the exact expected findings.
+
+    Fixtures shared across rule families tag lines ``# SEED: <rule-id>``;
+    when ``rule_id`` is given and such tags exist, only those lines are
+    claimed (older single-rule fixtures fall back to any ``# SEED``)."""
+    lines = path.read_text().splitlines()
+    if rule_id is not None:
+        tagged = [i for i, line in enumerate(lines, 1)
+                  if f"# SEED: {rule_id}" in line]
+        if tagged:
+            return tagged
+    return [i for i, line in enumerate(lines, 1) if "# SEED" in line]
 
 
 def run_rule_on(rule, path: Path, root: Path = REPO_ROOT):
@@ -54,6 +63,7 @@ class TestSeededFixtures:
         ("lockorder", LockOrderRule, "lock-order"),
         ("blocking", LockOrderRule, "lock-order"),
         ("race", CrossThreadRaceRule, "cross-thread-race"),
+        ("gateway", CrossThreadRaceRule, "cross-thread-race"),
         ("launch", CollectiveLaunchRule, "collective-launch"),
         ("megastep", CollectiveLaunchRule, "collective-launch"),
         ("spec", CollectiveLaunchRule, "collective-launch"),
@@ -64,7 +74,7 @@ class TestSeededFixtures:
     def test_bad_fixture_detected_at_exact_lines(self, stem, rule_cls,
                                                  rule_id):
         path = FIXTURES / f"{stem}_bad.py"
-        expected = seeded_lines(path)
+        expected = seeded_lines(path, rule_id)
         assert expected, f"{path} lost its SEED markers"
         findings = run_rule_on(rule_cls(), path)
         assert sorted(f.line for f in findings) == sorted(expected), [

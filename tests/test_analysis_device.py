@@ -50,6 +50,7 @@ CASES = [
     ("hostsync", HostSyncRule, "host-sync"),
     ("async", UseAfterDonateRule, "use-after-donate"),
     ("async", HostSyncRule, "host-sync"),
+    ("gateway", HostSyncRule, "host-sync"),
 ]
 
 

@@ -108,15 +108,18 @@ def _spread(rates):
 
 
 _SERVE_ARM_GROUPS = ("chunked", "megastep", "spec", "paged", "fleet",
-                     "prefix", "sampling", "async", "streaming")
+                     "prefix", "sampling", "async", "streaming", "slo")
 
 
 def _parse_serve_arms(spec):
-    """``--serve_arm`` selection: '' = every arm in one process (the
-    classic line); otherwise a comma list of groups from
-    ``_SERVE_ARM_GROUPS``, each runnable in its own subprocess — the
-    workaround for the nondeterministic glibc heap corruption the
-    full multi-arm single-process run can hit (see ROADMAP).  The core
+    """``--serve_arm`` selection: '' = every arm; otherwise a comma list
+    of groups from ``_SERVE_ARM_GROUPS``.  Whenever MORE than one arm is
+    selected the driver runs each arm in its own subprocess and merges
+    the JSON lines (``_serve_bench_isolated``) — the long multi-arm
+    single-process run hit a nondeterministic glibc heap corruption
+    (see ROADMAP), and isolation also keeps each arm's allocator state
+    independent of whichever arms ran before it.  A single named arm
+    (or 'core') runs in-process, unchanged.  The core
     fixed-vs-continuous pair ALWAYS runs: it carries the headline keys
     and every speedup denominator, so each selected arm stays
     self-contained."""
@@ -133,6 +136,58 @@ def _parse_serve_arms(spec):
                 f"{', '.join(_SERVE_ARM_GROUPS)}, or 'core')")
         arms.add(name)
     return arms
+
+
+def _serve_bench_isolated(flags, arms):
+    """Run each selected serve arm in its OWN subprocess (core + that
+    arm) and merge the JSON lines into the classic single line.
+
+    This is the fix for the nondeterministic glibc heap corruption the
+    long multi-arm single-process run could hit: one arm per process
+    bounds the blast radius, and a crash now names its arm in the error
+    instead of poisoning whichever arm ran after it.  Core keys come
+    from the FIRST child (each child re-runs the core pair for its
+    denominators; later copies are redundant); arm-specific keys are
+    disjoint by construction.  ``trace_events`` sums over children, and
+    ``--trace_out`` goes to the first child only (one process, one
+    coherent trace)."""
+    import subprocess
+    import sys
+
+    merged = {}
+    trace_events = 0
+    ordered = [a for a in _SERVE_ARM_GROUPS if a in arms]
+    for i, arm in enumerate(ordered):
+        cmd = [sys.executable, os.path.abspath(__file__), "--mode=serve",
+               f"--serve_arm={arm}",
+               f"--serve_requests={flags.serve_requests}"]
+        if flags.checkpoint_dir:
+            cmd.append(f"--checkpoint_dir={flags.checkpoint_dir}")
+        if flags.trace_out and i == 0:
+            cmd.append(f"--trace_out={flags.trace_out}")
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, text=True)
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"serve arm {arm!r} subprocess failed "
+                f"(exit {proc.returncode}): {' '.join(cmd)}")
+        line = None
+        for cand in reversed(proc.stdout.strip().splitlines()):
+            try:
+                line = json.loads(cand)
+                break
+            except json.JSONDecodeError:
+                continue
+        if line is None:
+            raise SystemExit(
+                f"serve arm {arm!r} subprocess printed no JSON line")
+        trace_events += int(line.pop("trace_events", 0))
+        line.pop("serve_arms", None)
+        for k, v in line.items():
+            merged.setdefault(k, v)
+    merged["serve_arms"] = sorted(arms)
+    merged["serve_arm_isolation"] = "subprocess"
+    merged["trace_events"] = trace_events
+    print(json.dumps(merged))
 
 
 def _streaming_arm(engine, cont, block_size):
@@ -229,6 +284,165 @@ def _streaming_arm(engine, cont, block_size):
     }
 
 
+def _slo_arm(engine, cont, block_size):
+    """SLO A/B over a deliberately undersized paged pool: low-priority
+    whales submitted first, then high-priority deadline-carrying shorts.
+    FIFO (slo off) strands the shorts behind the whales' blocks until
+    both whales retire; ranked admission (slo on) preempts the resident
+    whale — swapping its KV blocks to host RAM — admits the shorts
+    inside their deadline, and swaps the whale back in afterwards.
+
+    Hard asserts (contracts, not timing claims): preemption fired and
+    moved bytes during the timed phase; every request's greedy tokens —
+    INCLUDING the preempted whale's after its swap-in resume — are
+    bit-identical to the unpressured fixed-batch reference
+    (``preempt_resume_parity``); every KV block is back in the pool and
+    no payload left parked; deadline goodput with SLO on is no worse
+    than off; and NOTHING compiled after the warm pressure phase (the
+    block gather/scatter/rebind programs included — swap must never
+    recompile mid-traffic)."""
+    import threading
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.serve.continuous import (
+        ContinuousScheduler,
+    )
+
+    vocab = engine.module.cfg.vocab_size
+    rng = np.random.default_rng(cont.seed + 17)
+    whale_len, whale_new = 8, 40
+    short_len, short_new = 4, 8
+    max_total = whale_len + whale_new
+    blocks_whale = -(-(max_total - 1) // block_size)
+    blocks_short = -(-(short_len + short_new - 1) // block_size)
+    # Pool sizing is the whole experiment: a resident whale leaves LESS
+    # than one short's worth of free blocks (so a short can only run by
+    # preempting the whale), while a preempted whale frees enough for
+    # several shorts at once.  FIFO therefore serializes shorts behind
+    # ALL the whales' full decodes; ranked admission swaps the resident
+    # whale out and runs the shorts immediately.  Block 0 is trash.
+    pool = blocks_whale + blocks_short
+
+    def reference(prompt, horizon):
+        rows = engine.bucket_rows(1)
+        return engine.generate(
+            np.repeat(prompt[None, :], rows, axis=0), horizon)[0]
+
+    def run_phase(sched, deadline_ms):
+        whales = [rng.integers(0, vocab, size=(whale_len,), dtype=np.int32)
+                  for _ in range(3)]
+        shorts = [rng.integers(0, vocab, size=(short_len,), dtype=np.int32)
+                  for _ in range(4)]
+        decoding = threading.Event()
+        count = [0]
+
+        def on_tok(toks):
+            count[0] += len(toks)
+            if count[0] >= 4:
+                decoding.set()
+
+        wf = [sched.submit(whales[0], max_new_tokens=whale_new,
+                           sampling={"priority": 0}, on_token=on_tok)]
+        wf += [sched.submit(w, max_new_tokens=whale_new,
+                            sampling={"priority": 0}) for w in whales[1:]]
+        # The shorts arrive only once the resident whale is mid-decode,
+        # so preempting it has real KV bytes to move.
+        decoding.wait(timeout=600.0)
+        sampling = {"priority": 9}
+        if deadline_ms is not None:
+            sampling["deadline_ms"] = deadline_ms
+        sf = [sched.submit(p, max_new_tokens=short_new, sampling=sampling)
+              for p in shorts]
+        outs_w = [f.result(timeout=600.0) for f in wf]
+        outs_s = [f.result(timeout=600.0) for f in sf]
+        for p, o in zip(whales, outs_w):
+            np.testing.assert_array_equal(o, reference(p, whale_new))
+        for p, o in zip(shorts, outs_s):
+            np.testing.assert_array_equal(o, reference(p, short_new))
+        return sched.stats()
+
+    mk = dict(num_slots=4, max_total_len=max_total, cache_mode="paged",
+              block_size=block_size, num_blocks=pool)
+    sched_off = ContinuousScheduler(engine, **mk)
+    sched_on = ContinuousScheduler(engine, slo_scheduling=True,
+                                   swap_min_tokens=4, **mk)
+    try:
+        # Warm pressure phase: the same traffic shape (deadline-free, so
+        # the goodput tallies stay clean) through BOTH schedulers forces
+        # a preempt+swap+resume cycle on the slo side — compiling every
+        # prefill/decode shape AND the five tiering block programs
+        # before the compile counter is snapshotted.
+        run_phase(sched_off, None)
+        warm_stats = run_phase(sched_on, None)
+        assert warm_stats["preemptions_total"] > 0, (
+            "warm pressure phase never preempted — pool sizing is off: "
+            + str({k: warm_stats[k] for k in
+                   ("blocks_total", "blocks_in_use", "preempted_pending")}))
+        baseline_in_use = int(warm_stats["blocks_in_use"])
+        compile_warm = engine.compile_stats()["compile_total"]
+        # Time ONE unpressured whale post-warm (everything compiled, so
+        # this is pure decode wall time) and set the shorts' deadline to
+        # it: SLO-on admits a short within one preempt+prefill — a
+        # couple of scheduler iterations, ~10x under a whole whale's
+        # decode — while FIFO holds the shorts behind at least the two
+        # queued whales' FULL decodes (~2x over it).  Scaling with the
+        # measured time keeps both margins on fast and slow hosts alike;
+        # the floor only guards against timer jitter on absurdly fast
+        # decodes.
+        t0 = time.perf_counter()
+        sched_off.submit(
+            rng.integers(0, vocab, size=(whale_len,), dtype=np.int32),
+            max_new_tokens=whale_new).result(timeout=600.0)
+        t_whale = time.perf_counter() - t0
+        deadline_ms = max(50.0, t_whale * 1000.0)
+        off = run_phase(sched_off, deadline_ms)
+        on = run_phase(sched_on, deadline_ms)
+    finally:
+        sched_off.close()
+        sched_on.close()
+
+    def timed(key):
+        return int(on[key] - warm_stats[key])
+
+    compile_post_warmup = int(
+        engine.compile_stats()["compile_total"] - compile_warm)
+    goodput_on = (on["deadline_met_total"]
+                  / max(on["deadline_met_total"]
+                        + on["deadline_missed_total"], 1.0))
+    goodput_off = (off["deadline_met_total"]
+                   / max(off["deadline_met_total"]
+                         + off["deadline_missed_total"], 1.0))
+    assert timed("preemptions_total") > 0, (
+        "timed phase never preempted under block pressure")
+    assert timed("swap_bytes_total") > 0, (
+        "preemption never moved KV bytes through the host tier")
+    assert goodput_on >= goodput_off, (
+        f"SLO scheduling worsened deadline goodput: "
+        f"on={goodput_on:.3f} off={goodput_off:.3f}")
+    assert int(on["blocks_in_use"]) == baseline_in_use, (
+        f"preempt/resume leaked KV blocks: {int(on['blocks_in_use'])} "
+        f"in use vs {baseline_in_use} baseline")
+    assert int(on["swapped_resident"]) == 0, (
+        f"{int(on['swapped_resident'])} payloads left parked in host RAM")
+    assert compile_post_warmup == 0, (
+        f"SLO arm compiled {compile_post_warmup} programs after the "
+        f"warm pressure phase — swap/resume must reuse compiled programs")
+    return {
+        "goodput_slo_on": round(goodput_on, 4),
+        "goodput_slo_off": round(goodput_off, 4),
+        "slo_deadline_ms": round(deadline_ms, 1),
+        "preemptions_total": timed("preemptions_total"),
+        "preempt_swapped_total": timed("preempt_swapped_total"),
+        "preempt_recompute_total": timed("preempt_recompute_total"),
+        "resumes_total": timed("resumes_total"),
+        "swap_bytes_total": timed("swap_bytes_total"),
+        "preempt_resume_parity": True,  # hard-asserted above
+        "slo_blocks_in_use_after": int(on["blocks_in_use"]),
+        "slo_compile_post_warmup": compile_post_warmup,
+    }
+
+
 def _serve_bench(flags):
     """``--mode=serve``: both scheduling disciplines over ONE engine —
     fixed request-level batching, then continuous (iteration-level)
@@ -303,6 +517,12 @@ def _serve_bench(flags):
     workaround for the nondeterministic glibc heap corruption the
     long multi-arm process can hit.  Keys belonging to unselected arms
     are simply absent from the line."""
+    arms = _parse_serve_arms(flags.serve_arm)
+    if len(arms) > 1:
+        # More than one arm selected (including the default everything
+        # line): fan out one subprocess per arm and merge — the in-
+        # process multi-arm path is the one that corrupted the heap.
+        return _serve_bench_isolated(flags, arms)
     import dataclasses
 
     import jax
@@ -480,7 +700,6 @@ def _serve_bench(flags):
     # scheduler BEFORE the timed run, so the run itself must not
     # compile anything past warmup.
     mega_auto = dataclasses.replace(async_on, megastep="auto")
-    arms = _parse_serve_arms(flags.serve_arm)
     chunk_engine = engine
     if not on_tpu and ({"chunked", "megastep"} & arms):
         chunk_engine = ServeEngine(
@@ -795,6 +1014,8 @@ def _serve_bench(flags):
             })
         if "streaming" in arms:
             out.update(_streaming_arm(engine, continuous, block_size))
+        if "slo" in arms:
+            out.update(_slo_arm(engine, continuous, block_size))
     finally:
         engine.close()
         if chunk_engine is not engine:

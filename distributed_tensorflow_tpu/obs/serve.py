@@ -113,6 +113,25 @@ class ServeMonitorHook(Hook):
                     int(s.get("block_size", 0)),
                     s.get("kv_hbm_bytes", 0.0) / 2**20,
                 )
+            if s.get("slo_scheduling", 0):
+                # SLO scheduling: deadline goodput plus the preemption /
+                # host-tiering traffic — swap bytes climbing with goodput
+                # flat means the cost model is earning its keep; parked
+                # requests pinned high means the pool is undersized.
+                logger.info(
+                    "serve @ %d: slo goodput=%.2f (met=%d missed=%d) "
+                    "preempt=%d (swap=%d recompute=%d) resumed=%d "
+                    "parked=%d swap=%.1fMiB",
+                    step, s.get("deadline_goodput", 0.0),
+                    int(s.get("deadline_met_total", 0)),
+                    int(s.get("deadline_missed_total", 0)),
+                    int(s.get("preemptions_total", 0)),
+                    int(s.get("preempt_swapped_total", 0)),
+                    int(s.get("preempt_recompute_total", 0)),
+                    int(s.get("resumes_total", 0)),
+                    int(s.get("preempted_pending", 0)),
+                    s.get("swap_bytes_total", 0.0) / 2**20,
+                )
             if s.get("spec_k", 0):
                 # Speculative decoding: drafter yield and verify
                 # amortization — tok/launch > 1 is the win over the

@@ -190,6 +190,7 @@ from distributed_tensorflow_tpu.serve.paged import (
     megastep_coverage,
     spec_coverage,
 )
+from distributed_tensorflow_tpu.serve.tiering import HostKVPool, SwapPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -283,6 +284,25 @@ def _continuous_instruments(registry=None):
         "cancelled": r.counter(
             "dtt_serve_cancelled_total",
             "Requests cancelled by the client (queued or mid-decode)"),
+        "preemptions": r.counter(
+            "dtt_serve_preemptions_total",
+            "Requests evicted from their slot under block pressure "
+            "(SLO scheduling)"),
+        "resumes": r.counter(
+            "dtt_serve_resumes_total",
+            "Preempted requests re-admitted (swap-restore or recompute)"),
+        "swap_out_bytes": r.counter(
+            "dtt_kv_swap_out_bytes_total",
+            "KV bytes moved device -> host at preemption"),
+        "swap_in_bytes": r.counter(
+            "dtt_kv_swap_in_bytes_total",
+            "KV bytes moved host -> device at resume"),
+        "deadline_met": r.counter(
+            "dtt_serve_deadline_met_total",
+            "Completed requests whose TTFT met their deadline_ms"),
+        "deadline_missed": r.counter(
+            "dtt_serve_deadline_missed_total",
+            "Completed requests whose TTFT missed their deadline_ms"),
     })
     return out
 
@@ -346,6 +366,17 @@ class _SlotRequest:
     on_token: Optional[Any] = None
     streamed: int = 0
     cancelled: bool = False
+    # SLO scheduling: the ORIGINAL prompt length — the recompute resume
+    # path folds already-emitted tokens into ``prompt`` (re-prefill of
+    # the full history recreates the preempted K/V exactly), so every
+    # written-position computation must anchor to the BASE length, never
+    # ``len(prompt)`` — and how many times this request was preempted.
+    base_prompt_len: int = -1
+    preemptions: int = 0
+
+    def __post_init__(self):
+        if self.base_prompt_len < 0:
+            self.base_prompt_len = len(self.prompt)
 
     def prefilling(self) -> bool:
         return self.next_prefill_offset < len(self.prompt)
@@ -371,10 +402,12 @@ class _SlotRequest:
                 and self.tokens[-1] == self.eos_token)
 
     def max_written_tokens(self) -> int:
-        """Most K/V positions this request can ever write: the prompt plus
-        one per decode step (the last generated token never re-enters the
-        cache)."""
-        return len(self.prompt) + self.max_new_tokens - 1
+        """Most K/V positions this request can ever write: the BASE
+        prompt plus one per decode step (the last generated token never
+        re-enters the cache).  Anchored to ``base_prompt_len`` — after a
+        recompute resume, ``prompt`` holds prompt + emitted tokens, but
+        the physical ceiling never moves."""
+        return self.base_prompt_len + self.max_new_tokens - 1
 
 
 @dataclasses.dataclass
@@ -462,6 +495,9 @@ class ContinuousScheduler:
         async_decode: bool = False,
         spec_k: Optional[int] = None,
         spec_ngram: int = 3,
+        slo_scheduling: bool = False,
+        swap_min_tokens: int = 32,
+        starvation_age_s: float = 5.0,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -514,6 +550,16 @@ class ContinuousScheduler:
             raise ValueError(
                 f"spec_ngram must be >= 1 (longest history n-gram the "
                 f"prompt-lookup drafter matches), got {spec_ngram}")
+        if swap_min_tokens < 0:
+            raise ValueError(
+                f"swap_min_tokens must be >= 0 (contexts shorter than "
+                f"this recompute instead of swapping), got "
+                f"{swap_min_tokens}")
+        if starvation_age_s <= 0:
+            raise ValueError(
+                f"starvation_age_s must be > 0 (seconds of waiting per "
+                f"effective-priority step of starvation aging), got "
+                f"{starvation_age_s}")
         if self.megastep_auto and spec_k:
             raise ValueError(
                 "megastep='auto' tunes the fused-decode launch from its "
@@ -609,6 +655,23 @@ class ContinuousScheduler:
         self.kv_hbm_bytes = int(engine.cache_hbm_bytes(self._cache))
         self.kv_hbm_bytes_per_shard = int(
             engine.cache_hbm_bytes_per_shard(self._cache))
+        # SLO scheduling: priority/deadline-ranked admission plus
+        # preempt/swap/resume under block pressure.  Off (default) the
+        # admission loop is the classic head-of-line FIFO, bit-for-bit.
+        self.slo_scheduling = bool(slo_scheduling)
+        self.swap_min_tokens = int(swap_min_tokens)
+        self.starvation_age_s = float(starvation_age_s)
+        # Preempted requests parked between eviction and re-admission
+        # (loop-thread mutation, read under _lock by stats/cancel/drain).
+        self._preempted: List[_SlotRequest] = []
+        # Host-RAM KV tier: parks victims' private block bytes.  Paged
+        # mode only — dense slo scheduling still ranks admission but has
+        # no per-block residency to reclaim, so it never preempts.
+        self._tier_pool: Optional[HostKVPool] = None
+        if self.slo_scheduling and cache_mode == "paged":
+            self._tier_pool = HostKVPool(
+                engine, paged=self.paged,
+                policy=SwapPolicy(swap_min_tokens=self.swap_min_tokens))
         # paged: reserved-but-unallocated blocks, per shard
         self._reserved = [0] * shards
         self._blocks_per_request: collections.deque = collections.deque(
@@ -678,6 +741,15 @@ class ContinuousScheduler:
         self._cancelled = 0
         self._admitted = 0
         self._retired = 0
+        # SLO scheduling (under _lock): preempt/resume traffic and the
+        # TTFT-deadline goodput tallies.
+        self._preemptions = 0
+        self._preempt_swapped = 0
+        self._preempt_recompute = 0
+        self._resumes = 0
+        self._resumes_swapped = 0
+        self._deadline_met = 0
+        self._deadline_missed = 0
         # Prefix caching (under _lock): cacheable-block hit/miss totals
         # and prompt tokens whose prefill compute cache hits skipped.
         self._prefix_hits = 0
@@ -862,12 +934,23 @@ class ContinuousScheduler:
         carries the full result — cancellation lost the race, which the
         caller can observe via ``future.done()``)."""
         queued: Optional[_SlotRequest] = None
+        parked = False
         with self._cond:
             for i, r in enumerate(self._queue):
                 if r.rid == rid:
                     queued = r
                     del self._queue[i]
                     break
+            if queued is None:
+                # Preempted-and-parked requests hold no slot: cancel
+                # them here like queued ones (their parked host KV, if
+                # any, is dropped below, outside the lock).
+                for i, r in enumerate(self._preempted):
+                    if r.rid == rid:
+                        queued = r
+                        parked = True
+                        del self._preempted[i]
+                        break
             if queued is not None:
                 self._cancelled += 1
                 self._obs["cancelled"].inc()
@@ -885,7 +968,11 @@ class ContinuousScheduler:
                         return True
                 return False
         # Outside the lock: Future callbacks (gateway stream finishers)
-        # run inline on this thread.
+        # run inline on this thread.  The tier pool serializes ledger
+        # access internally, so the parked payload drop needs no
+        # scheduler lock.
+        if parked and self._tier_pool is not None:
+            self._tier_pool.drop(rid)
         queued.future.cancel()
         return True
 
@@ -943,8 +1030,13 @@ class ContinuousScheduler:
                 req.future.set_exception(ServeOverloadedError(
                     "scheduler draining: request shed before admission"))
         with self._cond:
+            # Preempted requests were already admitted once — their
+            # Futures are promised, so drain resumes them (the SLO
+            # admission pass considers parked requests even while
+            # draining) and waits for them too.
             finished = self._cond.wait_for(
-                lambda: not self._active or self._stopped,
+                lambda: ((not self._active and not self._preempted)
+                         or self._stopped),
                 timeout=max(0.0, deadline - time.monotonic()))
         return bool(finished)
 
@@ -994,6 +1086,18 @@ class ContinuousScheduler:
         # locked obs counters only, and runs BEFORE the scheduler lock so
         # no lock-order edge forms against the launch paths.
         compile_stats = self.engine.compile_stats()
+        # Host-KV-tier telemetry: the pool has its own lock, read it
+        # before the scheduler lock (same no-lock-order-edge discipline
+        # as compile_stats).  Zeros when tiering is off so dashboards,
+        # the fleet router, and the bench read one uniform key set.
+        if self._tier_pool is not None:
+            tier_stats = self._tier_pool.stats()
+        else:
+            tier_stats = {k: 0.0 for k in (
+                "swapped_resident", "swapped_bytes_resident",
+                "swap_out_bytes_total", "swap_in_bytes_total",
+                "swap_bytes_total", "swap_outs_total", "swap_ins_total",
+                "swap_dropped_total")}
         with self._lock:
             lat = sorted(self._latencies_ms)
             ttft = sorted(self._ttft_ms)
@@ -1091,6 +1195,26 @@ class ContinuousScheduler:
                 "sampling_configs_active": float(sampling_configs),
                 "programs_cached": compile_stats["programs_cached"],
                 "compile_total": compile_stats["compile_total"],
+                # SLO scheduling: preempt/resume traffic, parked
+                # requests, host-KV-tier bytes, and TTFT-deadline
+                # goodput (fraction of deadline-carrying completions
+                # whose first token met its deadline_ms).
+                "slo_scheduling": 1.0 if self.slo_scheduling else 0.0,
+                "preemptions_total": float(self._preemptions),
+                "preempt_swapped_total": float(self._preempt_swapped),
+                "preempt_recompute_total": float(
+                    self._preempt_recompute),
+                "resumes_total": float(self._resumes),
+                "resume_swapped_total": float(self._resumes_swapped),
+                "preempted_pending": float(len(self._preempted)),
+                "deadline_met_total": float(self._deadline_met),
+                "deadline_missed_total": float(self._deadline_missed),
+                "deadline_goodput": (
+                    self._deadline_met
+                    / (self._deadline_met + self._deadline_missed)
+                    if (self._deadline_met + self._deadline_missed)
+                    else 0.0),
+                **tier_stats,
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -1107,9 +1231,11 @@ class ContinuousScheduler:
         if self._thread.is_alive():
             self._thread.join(timeout)
         with self._cond:
-            leftover = list(self._queue) + list(self._active.values())
+            leftover = (list(self._queue) + list(self._active.values())
+                        + list(self._preempted))
             self._queue.clear()
             self._active.clear()
+            self._preempted.clear()
             self._free = list(range(self.num_slots))
         for req in leftover:
             if (not req.future.done()
@@ -1135,9 +1261,11 @@ class ContinuousScheduler:
             logger.exception("continuous scheduler loop died")
             with self._cond:
                 self._stopped = True
-                doomed = (list(self._queue) + list(self._active.values()))
+                doomed = (list(self._queue) + list(self._active.values())
+                          + list(self._preempted))
                 self._queue.clear()
                 self._active.clear()
+                self._preempted.clear()
                 self._failed += len(doomed)
                 self._obs["failed"].inc(len(doomed))
             for req in doomed:
@@ -1158,6 +1286,7 @@ class ContinuousScheduler:
         with self._cond:
             while (not self._stopped and not self._active
                    and not self._queue
+                   and not self._preempted
                    and self._pending_gen is None
                    and self._inflight is None):
                 self._cond.wait()
@@ -1197,31 +1326,32 @@ class ContinuousScheduler:
                     "hot-swapped params: generation %d -> %d "
                     "(%d request(s) still on the old weights)",
                     old.generation, self._gen.generation, old.refs)
-            while (self._queue and self._free
-                   and not self._draining):
-                idx = self._pick_slot_locked(self._queue[0])
-                if idx is None:
-                    break  # head of line waits on KV blocks
-                req = self._queue.popleft()
-                req.slot = self._free.pop(idx)
-                if self.paged is not None:
-                    # Reserve the worst-case block count now so a
-                    # mid-decode boundary cross can always be
-                    # served — admission is what waits on blocks,
-                    # never a half-decoded stream.
-                    req.reserved_blocks = self.paged.blocks_for(
-                        req.max_written_tokens())
-                    self._reserved[self._slot_shard[req.slot]] += (
-                        req.reserved_blocks)
-                req.gen = self._gen
-                self._gen.refs += 1
-                admits.append(req)
-            if (self.paged is not None and self._queue
-                    and self._free
-                    and self._queue[0].blocked_since is None):
-                # Head of line is waiting on BLOCKS, not slots:
-                # start its reservation-wait span.
-                self._queue[0].blocked_since = time.monotonic()
+            if not self.slo_scheduling:
+                while (self._queue and self._free
+                       and not self._draining):
+                    idx = self._pick_slot_locked(self._queue[0])
+                    if idx is None:
+                        break  # head of line waits on KV blocks
+                    req = self._queue.popleft()
+                    req.slot = self._free.pop(idx)
+                    if self.paged is not None:
+                        # Reserve the worst-case block count now so a
+                        # mid-decode boundary cross can always be
+                        # served — admission is what waits on blocks,
+                        # never a half-decoded stream.
+                        req.reserved_blocks = self.paged.blocks_for(
+                            req.max_written_tokens())
+                        self._reserved[self._slot_shard[req.slot]] += (
+                            req.reserved_blocks)
+                    req.gen = self._gen
+                    self._gen.refs += 1
+                    admits.append(req)
+                if (self.paged is not None and self._queue
+                        and self._free
+                        and self._queue[0].blocked_since is None):
+                    # Head of line is waiting on BLOCKS, not slots:
+                    # start its reservation-wait span.
+                    self._queue[0].blocked_since = time.monotonic()
             self._obs["depth"].set(len(self._queue))
             refill = (self.megastep > 1 and bool(admits)
                       and bool(self._queue) and bool(self._free)
@@ -1237,6 +1367,34 @@ class ContinuousScheduler:
                 logger.info(
                     "hot reload invalidated %d prefix-cached "
                     "block(s)", dropped)
+        if gen_swapped and self._tier_pool is not None:
+            # Parked private KV is a function of the weights that wrote
+            # it: a generation swap invalidates every swapped payload —
+            # those requests resume via the recompute path on the NEW
+            # generation.
+            with self._lock:
+                parked = list(self._preempted)
+            invalidated = 0
+            for r in parked:
+                if self._tier_pool.drop(r.rid):
+                    self._requeue_recompute(r)
+                    invalidated += 1
+            if invalidated:
+                logger.info(
+                    "hot reload invalidated %d swapped KV payload(s) "
+                    "-> recompute on resume", invalidated)
+        if self.slo_scheduling:
+            slo_admits, resumed = self._slo_admit()
+            admits += slo_admits
+            if admits or resumed:
+                with self._lock:
+                    # Same megastep admission-alignment refill as the
+                    # FIFO branch computed under its own lock hold
+                    # (megastep read included — autotune retunes it
+                    # from the loop thread under this lock).
+                    refill = (self.megastep > 1 and bool(self._queue)
+                              and bool(self._free)
+                              and not self._draining)
         self._admit(admits)
         self._prefill_step()
         if self._tracer.enabled:
@@ -1297,6 +1455,318 @@ class ContinuousScheduler:
             if headroom > best_headroom:
                 best, best_headroom = i, headroom
         return best
+
+    # -- SLO scheduling: ranked admission + preempt/swap/resume ---------------
+
+    def _eff_priority(self, req: _SlotRequest, now: float) -> int:
+        """Effective tier: the request's own priority plus one step per
+        ``starvation_age_s`` of waiting since submit (starvation aging —
+        background work climbs until nothing outranks it), clamped to
+        the top tier."""
+        p = req.sampling.priority if req.sampling is not None else 0
+        aged = int((now - req.submitted) / self.starvation_age_s)
+        return min(sampling_lib.MAX_PRIORITY, p + aged)
+
+    def _rank_key(self, req: _SlotRequest, now: float):
+        """Admission rank (ascending = admit first): effective priority
+        DESC, then deadline slack ASC (closest TTFT deadline first; no
+        deadline sorts last within the tier), then arrival."""
+        s = req.sampling
+        if s is not None and s.deadline_ms is not None:
+            slack = req.submitted + s.deadline_ms / 1000.0 - now
+        else:
+            slack = float("inf")
+        return (-self._eff_priority(req, now), slack, req.submitted,
+                req.rid)
+
+    def _pick_victim_locked(self, cand: _SlotRequest,
+                            now: float) -> Optional[_SlotRequest]:
+        """The active request to preempt so ``cand`` can admit: the
+        WORST-ranked resident whose effective priority is STRICTLY below
+        the candidate's — equal tiers never preempt each other (so the
+        top tier is never preempted: nothing outranks it), and a victim
+        aged up to the candidate's tier is protected.  None = nothing
+        preemptible; the candidate waits."""
+        if self._tier_pool is None:
+            return None
+        cand_p = self._eff_priority(cand, now)
+        victims = [r for r in self._active.values()
+                   if not r.cancelled and r.finished_at is None
+                   and self._eff_priority(r, now) < cand_p]
+        if not victims:
+            return None
+        return max(victims, key=lambda r: self._rank_key(r, now))
+
+    def _unpark_locked(self, req: _SlotRequest) -> bool:
+        """Remove ``req`` from the parked list by IDENTITY (dataclass
+        ``==`` compares numpy fields — never use ``in``/``remove``)."""
+        for i, r in enumerate(self._preempted):
+            if r is req:
+                del self._preempted[i]
+                return True
+        return False
+
+    def _slo_admit(self) -> Tuple[List[_SlotRequest], int]:
+        """Priority/deadline-ranked admission over the queue AND the
+        parked (preempted) requests, preempting lower-priority residents
+        under block pressure.  Returns (requests to ``_admit`` — fresh
+        plus recompute resumes, already holding slot + reservation +
+        pinned generation) and the count resumed in place by swap
+        restore.
+
+        Loop shape: rank all candidates under the lock, try to place the
+        best; on block pressure pick a victim and preempt it OUTSIDE the
+        lock (the eviction gathers KV through the engine's jitted block
+        programs and must flush the in-flight launch first — iteration
+        boundary), then retry.  Each preemption removes one resident, so
+        the walk terminates.  Parked requests are considered even while
+        draining: they were admitted once, their Futures are promised."""
+        admits: List[_SlotRequest] = []
+        resumed = 0
+        while True:
+            victim: Optional[_SlotRequest] = None
+            claimed: Optional[_SlotRequest] = None
+            swap_entry = None
+            with self._cond:
+                if self._stopped:
+                    break
+                now = time.monotonic()
+                cands: List[_SlotRequest] = list(self._preempted)
+                if not self._draining:
+                    cands.extend(self._queue)
+                if not cands or not self._free:
+                    break
+                cands.sort(key=lambda r: self._rank_key(r, now))
+                best = cands[0]
+                idx = self._pick_slot_locked(best)
+                if idx is None:
+                    victim = self._pick_victim_locked(best, now)
+                    if victim is None:
+                        break  # nothing outrankable resident: wait
+                else:
+                    from_parked = self._unpark_locked(best)
+                    if not from_parked:
+                        for i, r in enumerate(self._queue):
+                            if r is best:
+                                del self._queue[i]
+                                break
+                    best.slot = self._free.pop(idx)
+                    if self.paged is not None:
+                        best.reserved_blocks = self.paged.blocks_for(
+                            best.max_written_tokens())
+                        self._reserved[self._slot_shard[best.slot]] += (
+                            best.reserved_blocks)
+                    best.gen = self._gen
+                    self._gen.refs += 1
+                    if from_parked:
+                        self._resumes += 1
+                        self._obs["resumes"].inc()
+                        entry = (self._tier_pool.get(best.rid)
+                                 if self._tier_pool is not None else None)
+                        if (entry is not None and entry.generation
+                                == self._gen.generation):
+                            swap_entry = entry
+                    claimed = best
+                    self._obs["depth"].set(len(self._queue))
+            if victim is not None:
+                self._flush_inflight()
+                self._preempt(victim)
+                continue
+            if claimed is None:
+                break
+            if swap_entry is not None:
+                if self._resume_swapped(claimed, swap_entry):
+                    resumed += 1
+                    continue
+                # Shared prefix chain evicted while parked:
+                # _resume_swapped dropped the payload and reset the
+                # request for recompute — fall through to _admit.
+            elif (self._tier_pool is not None
+                    and self._tier_pool.drop(claimed.rid)):
+                # Parked payload from a superseded generation (staged
+                # swap raced the proactive invalidation): recompute.
+                self._requeue_recompute(claimed)
+            admits.append(claimed)
+        return admits, resumed
+
+    def _requeue_recompute(self, req: _SlotRequest) -> None:
+        """Reset a preempted request for the RECOMPUTE resume path: fold
+        the tokens emitted so far into the prompt — a re-prefill of the
+        full history writes the identical K/V at the identical positions
+        and its final chunk emits the genuinely-next token (the
+        written-positions invariant: after n emitted tokens the cache
+        held base+n-1 positions; re-prefill of base+n tokens lands
+        cache_index = base+n and emits token n) — and restart the chunk
+        state machine.  Penalty counts reset with the slot (documented
+        recompute-path limitation; the swap path restores them exactly).
+        Loop thread only; the request holds no slot."""
+        if req.tokens:
+            req.prompt = np.concatenate(
+                [req.prompt[:req.base_prompt_len],
+                 np.asarray(req.tokens, np.int32)])
+        if self.prefix_cache:
+            # Re-key over the extended prompt: the resumed request can
+            # re-map its own previously registered blocks if they still
+            # live in the prefix cache.
+            req.prefix_keys = chain_block_keys(req.prompt, self.block_size)
+        req.next_prefill_offset = 0
+        req.prefill_chunks = 0
+        req.prefix_cached = 0
+        req.prefill_idle = 0
+        req.prefill_started_at = None
+
+    def _preempt(self, req: _SlotRequest) -> None:
+        """Evict ``req`` from its slot under block pressure (loop thread;
+        the caller already flushed any in-flight launch, so this runs at
+        an iteration boundary with every emitted token on host).
+
+        Swap path: leading SHARED blocks (prefix-cache refcounts or
+        registrations — their bytes stay reachable through the cache)
+        are never moved, only counted; the private suffix's bytes gather
+        to the host tier when the cost model prefers the PCIe round-trip
+        over re-running prefill.  Recompute path: nothing moves, the
+        request's history folds into its prompt.  Either way the slot's
+        device residency tears down exactly like ``_retire`` — blocks
+        freed, table row to trash — and the request parks in
+        ``_preempted`` for ranked re-admission."""
+        slot = req.slot
+        shard = self._slot_shard[slot]
+        blocks = list(self._slot_blocks[slot])
+        was_prefilling = req.prefilling()
+        backlog_left = len(req.prompt) - req.next_prefill_offset
+        swapped_bytes = -1
+        if self._tier_pool is not None and req.tokens and not was_prefilling:
+            written = req.base_prompt_len + len(req.tokens) - 1
+            live = min(self.paged.blocks_for(written), len(blocks))
+            shared_n = 0
+            while (shared_n < live
+                   and self._allocator.is_shared(blocks[shared_n])):
+                shared_n += 1
+            private = blocks[shared_n:live]
+            per_block = self.kv_hbm_bytes // max(1, self.paged.num_blocks)
+            if self._tier_pool.policy.prefer_swap(
+                    len(private) * per_block, written):
+                entry = self._tier_pool.swap_out(
+                    self._cache, rid=req.rid, private_blocks=private,
+                    shared_blocks=shared_n, written=written,
+                    last_token=req.tokens[-1],
+                    generation=req.gen.generation,
+                    counts=self._counts, slot=slot)
+                swapped_bytes = entry.bytes
+        if swapped_bytes < 0:
+            self._requeue_recompute(req)
+        if blocks:
+            self._allocator.free(blocks)
+            self._slot_blocks[slot] = []
+        self._block_tables[slot, :] = self._allocator.trash_block(shard)
+        self._dev_block_tables = None  # host table reset
+        self._fresh[slot] = False
+        if self._tracer.enabled:
+            self._tracer.add_instant(
+                "preempt", cat="serve", tid=req.rid,
+                args={"request_id": req.rid, "slot": slot,
+                      "path": "swap" if swapped_bytes >= 0
+                      else "recompute",
+                      "swap_bytes": max(swapped_bytes, 0)})
+        with self._lock:
+            self._reserved[shard] -= req.reserved_blocks
+            req.reserved_blocks = 0
+            if req.gen is not None:
+                # Unpin the generation: the parked request re-pins at
+                # resume (swap payloads carry their generation tag and
+                # invalidate on mismatch).
+                req.gen.refs -= 1
+                if req.gen is not self._gen and req.gen.refs == 0:
+                    req.gen.params = None
+                req.gen = None
+            if was_prefilling:
+                self._prefilling -= 1
+                self._prefill_backlog -= backlog_left
+                self._obs["prefilling_slots"].set(self._prefilling)
+                self._obs["prefill_backlog"].set(self._prefill_backlog)
+            self._active.pop(slot, None)
+            self._free.append(slot)
+            req.slot = -1
+            req.preemptions += 1
+            self._preemptions += 1
+            if swapped_bytes >= 0:
+                self._preempt_swapped += 1
+                self._obs["swap_out_bytes"].inc(swapped_bytes)
+            else:
+                self._preempt_recompute += 1
+            self._preempted.append(req)
+            self._obs["preemptions"].inc()
+            self._obs["active_slots"].set(len(self._active))
+            self._cond.notify_all()
+        logger.debug(
+            "preempted request %d from slot %d (%s, %d token(s) emitted)",
+            req.rid, slot, "swap" if swapped_bytes >= 0 else "recompute",
+            len(req.tokens))
+
+    def _resume_swapped(self, req: _SlotRequest, entry) -> bool:
+        """Restore a swap-parked request into its freshly claimed slot:
+        re-acquire the shared prefix chain by key, allocate private
+        blocks and scatter the parked bytes back (donated cache rebound
+        through each program), rebind the block-table row, reset the
+        slot's index rows to the preemption-time written count, and
+        restore the penalty counts row — byte-exact resume, no prefill.
+        Returns False (after resetting the request for recompute) when
+        the shared chain was evicted while parked."""
+        slot = req.slot
+        shard = self._slot_shard[slot]
+        shared: List[int] = []
+        if entry.shared_blocks:
+            shared = self._allocator.acquire_prefix(
+                req.prefix_keys[:entry.shared_blocks], shard)
+            if len(shared) < entry.shared_blocks:
+                if shared:
+                    self._allocator.free(shared)
+                self._tier_pool.drop(req.rid)
+                self._requeue_recompute(req)
+                return False
+        fresh: List[int] = []
+        if entry.payloads:
+            fresh = self._allocator.allocate(
+                len(entry.payloads), slot=slot, shard=shard)
+            self._cache = self._tier_pool.swap_in(
+                self._cache, rid=req.rid, blocks=fresh)
+        self._counts = self._tier_pool.restore_counts(
+            self._counts, rid=req.rid, slot=slot)
+        blocks = shared + fresh
+        if blocks:
+            self._block_tables[slot, :len(blocks)] = blocks
+        self._dev_block_tables = None  # host table changed
+        self._slot_blocks[slot] = list(blocks)
+        self._cache = self.engine.bind_slot_rows(
+            self._cache, [slot], [entry.written])
+        self._last_tok[slot, 0] = entry.last_token
+        if self.async_decode:
+            self._fresh[slot] = True
+        else:
+            self._dev_last_tok = None  # host vector is newer
+        req.next_prefill_offset = len(req.prompt)  # not prefilling
+        if self._tracer.enabled:
+            self._tracer.add_instant(
+                "resume_swap", cat="serve", tid=req.rid,
+                args={"request_id": req.rid, "slot": slot,
+                      "swap_bytes": int(entry.bytes),
+                      "shared_blocks": int(entry.shared_blocks)})
+        self._tier_pool.take(req.rid)
+        with self._lock:
+            release = min(req.reserved_blocks, len(blocks))
+            req.reserved_blocks -= release
+            self._reserved[shard] -= release
+            self._admitted += 1
+            self._resumes_swapped += 1
+            self._active[slot] = req
+            self._obs["admissions"].inc()
+            self._obs["swap_in_bytes"].inc(int(entry.bytes))
+            self._obs["active_slots"].set(len(self._active))
+        logger.debug(
+            "resumed request %d into slot %d by swap restore "
+            "(%d shared + %d private block(s))",
+            req.rid, slot, len(shared), len(fresh))
+        return True
 
     def _ensure_blocks(self, req: _SlotRequest, tokens_written: int) -> None:
         """Allocate-on-boundary-cross: grow the slot's block list (and its
@@ -1489,7 +1959,8 @@ class ContinuousScheduler:
                 self.engine.prefill_into_slots(
                     self._cache, req.prompt[None, off:off + chunk],
                     [req.slot],
-                    sampling=sampling_lib.pack([req.sampling], [0]),
+                    sampling=sampling_lib.pack(
+                        [req.sampling], [len(req.tokens)]),
                     counts=self._counts, commit=np.array([final]),
                     counter=self._next_counter(), params=req.gen.params,
                     start_offsets=[off] if off else None,
@@ -1497,10 +1968,16 @@ class ContinuousScheduler:
             spent += chunk
             req.next_prefill_offset = off + chunk
             req.prefill_chunks += 1
+            first_decoded = False
             if final:
                 tok = int(self._fetch_host(tok_dev)[0])
-                req.first_token_at = time.monotonic()
-                req.last_token_at = req.first_token_at
+                now = time.monotonic()
+                # A recompute-resumed request already stamped its TTFT
+                # on its first admission — never restamp.
+                first_decoded = req.first_token_at is None
+                if first_decoded:
+                    req.first_token_at = now
+                req.last_token_at = now
                 req.tokens.append(tok)
                 self._last_tok[req.slot, 0] = tok
                 if self.async_decode:
@@ -1525,7 +2002,7 @@ class ContinuousScheduler:
                     self._tracer.add_span(
                         "prefill", cat="serve", tid=req.rid,
                         start=req.prefill_started_at,
-                        end=req.first_token_at,
+                        end=now,
                         args={"request_id": req.rid, "slot": req.slot,
                               "prompt_len": int(len(req.prompt)),
                               "prefix_tokens_cached": int(
@@ -1537,8 +2014,9 @@ class ContinuousScheduler:
                 self._obs["prefill_chunk"].observe(chunk)
                 if final:
                     self._prefilling -= 1
-                    self._obs["ttft"].observe(
-                        req.first_token_at - req.submitted)
+                    if first_decoded:
+                        self._obs["ttft"].observe(
+                            req.first_token_at - req.submitted)
                 self._obs["prefilling_slots"].set(self._prefilling)
                 self._obs["prefill_backlog"].set(self._prefill_backlog)
             if final:
@@ -1615,7 +2093,8 @@ class ContinuousScheduler:
             # The upcoming step writes each slot's position
             # prompt + len(tokens) - 1; cross a block boundary -> allocate.
             req = decoding[slot]
-            self._ensure_blocks(req, len(req.prompt) + len(req.tokens))
+            self._ensure_blocks(
+                req, req.base_prompt_len + len(req.tokens))
         # Group rows by pinned weight generation: mid-reload, rows admitted
         # before the swap keep decoding on their own params — one step per
         # live generation, oldest first (normally exactly one group, and
@@ -1755,7 +2234,7 @@ class ContinuousScheduler:
             # never past the admission reservation (a short-horizon row
             # stops advancing on device before it would need more).
             self._ensure_blocks(req, megastep_coverage(
-                len(req.prompt), len(req.tokens) + inflight, K,
+                req.base_prompt_len, len(req.tokens) + inflight, K,
                 req.max_new_tokens))
         if not active_slots:
             return None
@@ -2079,7 +2558,7 @@ class ContinuousScheduler:
             # accepted drafts), clamped to the admission reservation.
             req = decoding[slot]
             self._ensure_blocks(req, spec_coverage(
-                len(req.prompt), len(req.tokens),
+                req.base_prompt_len, len(req.tokens),
                 int(draft_lens[slot]), req.max_new_tokens))
         by_gen: Dict[int, List[int]] = {}
         for slot in active_slots:
@@ -2286,6 +2765,20 @@ class ContinuousScheduler:
                     req.finished_at - req.submitted)
                 self._latencies_ms.append(
                     (req.finished_at - req.submitted) * 1e3)
+                dl = (req.sampling.deadline_ms
+                      if req.sampling is not None else None)
+                if dl is not None:
+                    # TTFT-deadline goodput: a completion counts as good
+                    # when its FIRST token landed inside deadline_ms.
+                    met = (req.first_token_at is not None
+                           and (req.first_token_at - req.submitted)
+                           * 1000.0 <= dl)
+                    if met:
+                        self._deadline_met += 1
+                        self._obs["deadline_met"].inc()
+                    else:
+                        self._deadline_missed += 1
+                        self._obs["deadline_missed"].inc()
                 if req.first_token_at is not None:
                     self._ttft_ms.append(
                         (req.first_token_at - req.submitted) * 1e3)

@@ -129,6 +129,19 @@ class ServeArgs:
     spec_k: int = 0
     # Longest history n-gram the drafter matches (it backs off to 1).
     spec_ngram: int = 3
+    # SLO-aware scheduling (continuous only): admission ranks requests
+    # by (priority tier, deadline slack, arrival) instead of FIFO, and —
+    # paged mode — block pressure preempts the lowest tier, swapping its
+    # KV blocks to host RAM (or dropping them for recompute, whichever
+    # the cost model picks) and resuming when pressure clears.
+    slo_scheduling: bool = False
+    # Contexts shorter than this always take the recompute path on
+    # preemption (re-prefill beats moving a few KV bytes twice).
+    swap_min_tokens: int = 32
+    # Starvation aging: a queued request gains one effective priority
+    # tier per this many seconds waited, so tier 0 cannot starve forever
+    # behind a steady tier-9 stream.
+    starvation_age_s: float = 5.0
     # Repetitive traffic mix: >0 builds each prompt's tail by tiling a
     # motif of this many tokens instead of i.i.d. random tokens — the
     # structured/repetitive workload prompt-lookup drafting wins on
@@ -177,6 +190,10 @@ class ServeArgs:
     # Gateway admission limit: requests in flight beyond this answer
     # 429 with a Retry-After header instead of queueing unboundedly.
     max_inflight: int = 64
+    # >0 tiers the gateway's inflight gate: priority p's limit is
+    # max_inflight - (9 - p) * priority_headroom (floored at 1), so
+    # under load the lowest tiers shed (429) first.
+    priority_headroom: int = 0
     # "" = tracing off; a path enables the flight recorder and writes the
     # Chrome trace-event JSON (Perfetto-loadable) there at shutdown.
     trace_out: str = ""
@@ -213,6 +230,17 @@ def _cache_kwargs(args: ServeArgs) -> Dict[str, Any]:
         "kv_dtype": args.kv_dtype or None,
         "per_shard_kv": args.per_shard_kv,
         "prefix_cache": args.prefix_cache,
+    }
+
+
+def _slo_kwargs(args: ServeArgs) -> Dict[str, Any]:
+    """ContinuousScheduler SLO kwargs from the flag surface."""
+    if not args.slo_scheduling:
+        return {}
+    return {
+        "slo_scheduling": True,
+        "swap_min_tokens": args.swap_min_tokens,
+        "starvation_age_s": args.starvation_age_s,
     }
 
 
@@ -355,6 +383,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
+            **_slo_kwargs(args),
             **_cache_kwargs(args),
         )
         return DynamicBatcher(iteration_level=True, scheduler=scheduler)
@@ -415,6 +444,7 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
+            **_slo_kwargs(args),
             name=f"serve-fleet-r{i}",
             **_cache_kwargs(args),
         )
@@ -461,6 +491,7 @@ def _resolve_megastep(args: ServeArgs, engine: ServeEngine,
         async_decode=args.async_decode,
         spec_k=args.spec_k or None,
         spec_ngram=args.spec_ngram,
+        **_slo_kwargs(args),
         **warm_kwargs,
     )
     try:
@@ -519,6 +550,7 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
             async_decode=args.async_decode,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
+            **_slo_kwargs(args),
             **warm_kwargs)
         lengths = sorted({_payload_parts(p)[0].shape[0] for p in payloads})
         warm_lengths = set(lengths)
@@ -556,6 +588,12 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             "--sampling_mix requires the continuous gpt2 path "
             "(--continuous); per-request sampling rides the slot "
             "programs' runtime vectors")
+    if args.slo_scheduling and not (args.model == "gpt2"
+                                    and args.continuous):
+        raise ValueError(
+            "--slo_scheduling requires the continuous gpt2 path "
+            "(--continuous); fixed-batch scheduling has no admission "
+            "ranking or preemption")
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
     megastep_auto = args.megastep == "auto"
@@ -585,9 +623,13 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         # The front door rides the SAME backend the synthetic clients
         # drive in-process — routing, hot reload, and drain compose.
         gateway = GatewayServer(batcher, port=args.gateway_port,
-                                max_inflight=args.max_inflight)
-        logger.info("gateway listening on %s:%d (max_inflight=%d)",
-                    gateway.host, gateway.port, args.max_inflight)
+                                max_inflight=args.max_inflight,
+                                priority_headroom=args.priority_headroom)
+        logger.info(
+            "gateway listening on %s:%d (max_inflight=%d, "
+            "priority_headroom=%d)",
+            gateway.host, gateway.port, args.max_inflight,
+            args.priority_headroom)
     monitor = ServeMonitorHook(batcher, every_steps=args.log_every)
     futures: List[Any] = [None] * len(payloads)
     rejected = [0]
@@ -728,6 +770,23 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             out["spec_emitted"] = int(stats.get("spec_emitted", 0.0))
             out["spec_acceptance_rate"] = round(
                 stats.get("spec_acceptance_rate", 0.0), 4)
+        out["slo_scheduling"] = bool(args.slo_scheduling)
+        if args.slo_scheduling:
+            out["preemptions_total"] = int(
+                stats.get("preemptions_total", 0.0))
+            out["preempt_swapped_total"] = int(
+                stats.get("preempt_swapped_total", 0.0))
+            out["preempt_recompute_total"] = int(
+                stats.get("preempt_recompute_total", 0.0))
+            out["resumes_total"] = int(stats.get("resumes_total", 0.0))
+            out["swap_bytes_total"] = int(
+                stats.get("swap_bytes_total", 0.0))
+            out["deadline_met_total"] = int(
+                stats.get("deadline_met_total", 0.0))
+            out["deadline_missed_total"] = int(
+                stats.get("deadline_missed_total", 0.0))
+            out["deadline_goodput"] = round(
+                stats.get("deadline_goodput", 0.0), 4)
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
         if args.cache_mode == "paged":

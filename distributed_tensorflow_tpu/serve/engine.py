@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from distributed_tensorflow_tpu import cluster as cluster_lib
 from distributed_tensorflow_tpu.checkpoint import CheckpointManager
@@ -278,6 +279,12 @@ class ServeEngine:
                 f"or dedicate a pipe-free mesh slice to serving")
         self._manager: Optional[CheckpointManager] = None
         self._generate_fns: Dict[Any, Callable] = {}
+        # KV-tiering block programs live in their own cache: they donate
+        # their FIRST argument (the cache/counts being rewritten), unlike
+        # every decode program in _generate_fns (params first, cache
+        # donated at position 1) — one dict per donation signature keeps
+        # the donated-position story uniform within each cache.
+        self._block_fns: Dict[Any, Callable] = {}
         self._cache_init_fns: Dict[Any, Callable] = {}
         self._obs = _engine_instruments()
         self.restored_step: Optional[int] = None
@@ -851,6 +858,154 @@ class ServeEngine:
             return jax.device_put(
                 np.asarray(arr),
                 NamedSharding(self.mesh, PartitionSpec()))
+
+    # -- KV tiering: per-block swap to host RAM and back ----------------------
+
+    #: Paged-pool cache leaves the tiering swap path moves per block —
+    #: leaf name -> block-axis offset from the END of the shape (pools
+    #: are (..., num_blocks, bs, H, hd), scale tables (..., num_blocks,
+    #: bs)); counting from the end keeps the slice correct whether or
+    #: not the scanned layer stack adds a leading dim.
+    _POOL_BLOCK_AXES = {
+        "cached_key_pool": 4,
+        "cached_value_pool": 4,
+        "key_scale": 2,
+        "value_scale": 2,
+    }
+
+    @classmethod
+    def _pool_leaf_paths(cls, cache: PyTree) -> List[Tuple[str, str]]:
+        """Deterministic (keystr, leaf name) order of the pool leaves —
+        the payload layout contract between gather and scatter."""
+        found: List[Tuple[str, str]] = []
+
+        def _grab(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name in cls._POOL_BLOCK_AXES:
+                found.append((jax.tree_util.keystr(path), name))
+            return leaf
+
+        jax.tree_util.tree_map_with_path(_grab, cache)
+        found.sort()
+        return found
+
+    def _gather_block_apply(self, cache, block):
+        """ONE physical block's slice of every pool leaf (K, V, and the
+        f32 scale tables under int8) — the per-block swap-out payload."""
+        out = []
+        slices = {}
+
+        def _grab(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            ax_end = self._POOL_BLOCK_AXES.get(name)
+            if ax_end is not None:
+                slices[jax.tree_util.keystr(path)] = lax.dynamic_index_in_dim(
+                    leaf, block, axis=leaf.ndim - ax_end, keepdims=False)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(_grab, cache)
+        for keystr in sorted(slices):
+            out.append(slices[keystr])
+        return out
+
+    def _scatter_block_apply(self, cache, block, payload):
+        """Write a gathered block payload back into physical ``block`` of
+        every pool leaf — the swap-in restore.  Byte-exact inverse of
+        ``_gather_block_apply`` (same leaf order, same dtypes)."""
+        order = {k: i for i, (k, _n) in
+                 enumerate(self._pool_leaf_paths(cache))}
+
+        def _put(path, leaf):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            ax_end = self._POOL_BLOCK_AXES.get(name)
+            if ax_end is None:
+                return leaf
+            axis = leaf.ndim - ax_end
+            update = jnp.expand_dims(
+                jnp.asarray(payload[order[jax.tree_util.keystr(path)]],
+                            leaf.dtype), axis)
+            return lax.dynamic_update_slice_in_dim(leaf, update, block, axis)
+
+        return jax.tree_util.tree_map_with_path(_put, cache)
+
+    def _bind_rows_apply(self, cache, slot_ids, starts):
+        return self._reset_slot_rows(cache, slot_ids, starts)
+
+    def _counts_row_apply(self, counts, slot):
+        return counts[slot]
+
+    def _counts_bind_apply(self, counts, slot, row):
+        return counts.at[slot].set(row)
+
+    def gather_kv_block(self, cache: PyTree, block: int, *, paged) -> list:
+        """Fetch ONE physical block of the paged pools to HOST memory:
+        a jitted per-leaf slice launch followed by the sanctioned
+        ``jax.device_get`` — the KV tiering swap-out unit.  Runs at
+        iteration boundaries only (the scheduler calls it after flushing
+        any in-flight launch), under the process launch lock like every
+        other device op.  Scale tables travel with their blocks, so an
+        int8 pool round-trips bit-exactly."""
+        key = ("block_gather", paged)
+        with _launch_lock:
+            if key not in self._block_fns:
+                self._note_compile("block_gather")
+                self._block_fns[key] = jax.jit(self._gather_block_apply)
+            slices = self._block_fns[key](cache, np.int32(block))
+            return jax.device_get(slices)
+
+    def scatter_kv_block(self, cache: PyTree, block: int, payload: list,
+                         *, paged) -> PyTree:
+        """Write a host payload from ``gather_kv_block`` into physical
+        ``block`` — the swap-in restore.  The cache is donated through
+        the call; callers rebind (``cache = engine.scatter_kv_block(
+        cache, ...)``), exactly the donated-cache chaining discipline."""
+        key = ("block_scatter", paged)
+        with _launch_lock:
+            if key not in self._block_fns:
+                self._note_compile("block_scatter")
+                self._block_fns[key] = jax.jit(
+                    self._scatter_block_apply, donate_argnums=(0,))
+            return self._block_fns[key](cache, np.int32(block), payload)
+
+    def bind_slot_rows(self, cache: PyTree, slot_ids, starts) -> PyTree:
+        """Set ``cache_index``/``position`` rows for ``slot_ids`` to
+        ``starts`` as a standalone program — the resume rebind for a
+        swapped-in request (its restored blocks already hold positions
+        ``< start``; decode continues from there without a prefill).
+        The cache is donated; callers rebind."""
+        key = ("slot_bind",)
+        with _launch_lock:
+            if key not in self._block_fns:
+                self._note_compile("slot_bind")
+                self._block_fns[key] = jax.jit(
+                    self._bind_rows_apply, donate_argnums=(0,))
+            return self._block_fns[key](
+                cache, np.asarray(slot_ids, np.int32),
+                np.asarray(starts, np.int32))
+
+    def gather_counts_row(self, counts: jax.Array, slot: int) -> np.ndarray:
+        """One slot's emitted-token count row to host — swapped out with
+        the victim's KV so presence/frequency penalties survive a
+        preempt/resume round-trip bit-exactly."""
+        key = ("counts_gather",)
+        with _launch_lock:
+            if key not in self._block_fns:
+                self._note_compile("counts_gather")
+                self._block_fns[key] = jax.jit(self._counts_row_apply)
+            row = self._block_fns[key](counts, np.int32(slot))
+            return np.asarray(jax.device_get(row))
+
+    def scatter_counts_row(self, counts: jax.Array, slot: int,
+                           row: np.ndarray) -> jax.Array:
+        """Restore a saved count row into ``slot``; counts donated."""
+        key = ("counts_bind",)
+        with _launch_lock:
+            if key not in self._block_fns:
+                self._note_compile("counts_bind")
+                self._block_fns[key] = jax.jit(
+                    self._counts_bind_apply, donate_argnums=(0,))
+            return self._block_fns[key](
+                counts, np.int32(slot), np.asarray(row, np.int32))
 
     def _megastep_apply(self, steps, paged, params, cache, counts, tokens,
                         active, horizon, eos_rows, block_tables, rng,

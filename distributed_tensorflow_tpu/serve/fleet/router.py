@@ -86,8 +86,17 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     effective depth of one additional megastep.  Same 2x saturation,
     and homogeneous fleets (all-async or all-sync) keep identical
     rankings.
+
+    SLO preemption adds hidden demand: a parked (preempted) request
+    holds no slot and no blocks, but it WILL re-claim both the moment
+    pressure clears — so ``preempted_pending`` counts into the queue
+    term (a replica that had to preempt is by definition out of blocks),
+    and each swapped-out payload adds to KV pressure (its bytes must fit
+    back into the pool before that request decodes again).  Both are
+    zero with SLO scheduling off, so legacy fleets rank unchanged.
     """
-    depth = stats.get("queue_depth", 0.0)
+    depth = (stats.get("queue_depth", 0.0)
+             + stats.get("preempted_pending", 0.0))
     cap = max(1.0, stats.get("capacity", 1.0))
     active = stats.get("active_slots", 0.0)
     slots = max(1.0, stats.get("num_slots", 1.0))
@@ -95,6 +104,9 @@ def replica_load_score(stats: Dict[str, float]) -> float:
     total = stats.get("blocks_total", 0.0)
     free = stats.get("blocks_free", 0.0)
     kv_pressure = (1.0 - free / total) if total else 0.0
+    # Swapped payloads are deferred pool demand: saturate at +0.5 so
+    # the in-use signal still dominates the KV term.
+    kv_pressure += min(0.5, 0.1 * stats.get("swapped_resident", 0.0))
     mega = max(1.0, stats.get("megastep", 1.0))
     if stats.get("async_decode", 0.0):
         mega *= 2.0  # one extra megastep always in flight
@@ -272,6 +284,11 @@ class FleetRouter:
         "megastep_launches", "megastep_tokens", "megastep_effective_steps",
         "spec_launches", "spec_drafted", "spec_accepted", "spec_emitted",
         "programs_cached", "compile_total", "sampling_configs_active",
+        "preemptions_total", "preempt_swapped_total",
+        "preempt_recompute_total", "resumes_total", "resume_swapped_total",
+        "preempted_pending", "swapped_resident", "swapped_bytes_resident",
+        "swap_out_bytes_total", "swap_in_bytes_total", "swap_bytes_total",
+        "deadline_met_total", "deadline_missed_total",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
@@ -280,7 +297,7 @@ class FleetRouter:
         "queue_wait_p50_ms", "queue_wait_p99_ms",
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
         "param_generation", "prefill_budget", "megastep", "spec_k",
-        "async_decode", "device_idle_fraction",
+        "async_decode", "device_idle_fraction", "slo_scheduling",
     )
 
     def stats(self) -> Dict[str, float]:
@@ -308,6 +325,9 @@ class FleetRouter:
         out["spec_tokens_per_launch"] = (
             out["spec_emitted"] / out["spec_launches"]
             if out["spec_launches"] else 0.0)
+        scored = out["deadline_met_total"] + out["deadline_missed_total"]
+        out["deadline_goodput"] = (
+            out["deadline_met_total"] / scored if scored else 0.0)
         with self._lock:
             out["replicas"] = float(len(self.replicas))
             out["shed"] = float(self._shed)

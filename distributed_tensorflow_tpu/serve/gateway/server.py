@@ -22,7 +22,13 @@ Admission control: past ``max_inflight`` open requests the gateway
 answers ``429`` with a ``Retry-After`` header instead of queueing —
 bounded end-to-end, because the backend's own admission queue is the
 only queue.  Backend sheds (``ServeOverloadedError``) map to the same
-``429``.
+``429``.  SLO requests carry top-level ``priority`` (int tier [0, 9])
+and ``deadline_ms`` body keys (or the same keys inside ``sampling``);
+bad ranges answer ``400`` before anything reaches the backend.  With
+``priority_headroom`` > 0 the inflight gate is TIERED: tier p's limit
+is ``max_inflight - (9 - p) * priority_headroom`` (floored at 1), so
+under load the lowest tiers shed first while the top tier keeps the
+whole gate.
 
 Threading: HTTP handlers run on per-connection server threads and touch
 only gateway-owned state (each under its own lock) plus the thread-safe
@@ -44,6 +50,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+from distributed_tensorflow_tpu.serve import sampling as sampling_lib
 from distributed_tensorflow_tpu.serve.batcher import ServeOverloadedError
 from distributed_tensorflow_tpu.serve.gateway.cancel import CancelRegistry
 from distributed_tensorflow_tpu.serve.gateway.streams import (
@@ -57,6 +64,33 @@ logger = logging.getLogger(__name__)
 # Payload keys forwarded verbatim from the HTTP body to the backend's
 # dict-payload submit surface.
 _FORWARD_KEYS = ("max_new_tokens", "eos_token", "sampling")
+
+
+def _merge_slo_fields(body: Dict[str, Any], payload: Dict[str, Any]) -> int:
+    """Fold top-level ``priority``/``deadline_ms`` body keys into the
+    payload's sampling dict (the scheduler's one SLO surface) and return
+    the request's effective tier.  Range errors raise ``ValueError`` —
+    the handler maps them to 400 — so a bad tier never reaches the
+    backend queue."""
+    sampling = payload.get("sampling")
+    if sampling is not None and not isinstance(sampling, dict):
+        raise ValueError(
+            "sampling must be a JSON object of SamplingParams kwargs")
+    sampling = dict(sampling) if sampling else {}
+    for key in ("priority", "deadline_ms"):
+        if body.get(key) is not None:
+            if key in sampling and sampling[key] != body[key]:
+                raise ValueError(
+                    f"{key} given both top-level and inside sampling "
+                    f"with different values")
+            sampling[key] = body[key]
+    if sampling:
+        # Validates priority ∈ [0, 9] and deadline_ms > 0 right here on
+        # the handler thread; the payload still carries the plain dict.
+        sampling_lib.coerce(sampling)
+        payload["sampling"] = sampling
+    p = sampling.get("priority", 0)
+    return int(p)
 
 
 class _GatewayHTTPServer(ThreadingHTTPServer):
@@ -83,6 +117,7 @@ class GatewayServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_inflight: int = 64,
+        priority_headroom: int = 0,
         retry_after_s: int = 1,
         keepalive_s: float = 5.0,
         stream_max_events: int = 256,
@@ -93,8 +128,12 @@ class GatewayServer:
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1, got {max_inflight}")
+        if priority_headroom < 0:
+            raise ValueError(
+                f"priority_headroom must be >= 0, got {priority_headroom}")
         self._backend = backend
         self.max_inflight = int(max_inflight)
+        self.priority_headroom = int(priority_headroom)
         self.retry_after_s = int(retry_after_s)
         self.keepalive_s = float(keepalive_s)
         self.stream_max_events = int(stream_max_events)
@@ -104,6 +143,7 @@ class GatewayServer:
         self._lock = threading.Lock()
         self._inflight = 0
         self._accepted = 0
+        self._accepted_by_tier: Dict[int, int] = {}
         self._throttled = 0
         self._disconnects = 0
         self._cancel_requests = 0
@@ -121,7 +161,21 @@ class GatewayServer:
 
     # -- request lifecycle ---------------------------------------------------
 
-    def open_request(self, payload: Dict[str, Any], *, stream: bool
+    def limit_for(self, priority: int) -> int:
+        """Tier-aware inflight limit: with ``priority_headroom`` h, tier
+        p may use ``max_inflight - (9 - p) * h`` seats (floored at 1) —
+        under load the LOWEST tiers hit their ceiling first and shed
+        with 429 while the top tier keeps the full gate.  h = 0 is the
+        legacy single-gate behaviour."""
+        if self.priority_headroom <= 0:
+            return self.max_inflight
+        p = min(max(int(priority), sampling_lib.MIN_PRIORITY),
+                sampling_lib.MAX_PRIORITY)
+        return max(1, self.max_inflight
+                   - (sampling_lib.MAX_PRIORITY - p) * self.priority_headroom)
+
+    def open_request(self, payload: Dict[str, Any], *, stream: bool,
+                     priority: int = 0
                      ) -> Tuple[str, Any, Optional[TokenStream]]:
         """Admission + submit + registration for one HTTP request.
 
@@ -133,12 +187,14 @@ class GatewayServer:
         with self._lock:
             if self._closed:
                 raise RuntimeError("gateway is closed")
-            if self._inflight >= self.max_inflight:
+            limit = self.limit_for(priority)
+            if self._inflight >= limit:
                 self._throttled += 1
                 self._obs["gateway_throttled"].inc()
                 raise ServeOverloadedError(
-                    f"gateway at max_inflight "
-                    f"({self._inflight}/{self.max_inflight} open)")
+                    f"gateway at tier-{int(priority)} inflight limit "
+                    f"({self._inflight}/{limit} open, "
+                    f"max_inflight {self.max_inflight})")
             self._inflight += 1
             self._obs["gateway_inflight"].set(float(self._inflight))
         ts: Optional[TokenStream] = None
@@ -167,6 +223,9 @@ class GatewayServer:
             lambda f: self._finish(gid, f, ts, eos, want))
         with self._lock:
             self._accepted += 1
+            tier = int(priority)
+            self._accepted_by_tier[tier] = \
+                self._accepted_by_tier.get(tier, 0) + 1
         self._obs["gateway_accepted"].inc()
         return gid, fut, ts
 
@@ -249,15 +308,19 @@ class GatewayServer:
     def stats(self) -> Dict[str, float]:
         depth = self._depth.value()  # meter lock, before the gateway lock
         with self._lock:
-            return {
+            out = {
                 "gateway_inflight": float(self._inflight),
                 "gateway_max_inflight": float(self.max_inflight),
+                "gateway_priority_headroom": float(self.priority_headroom),
                 "gateway_accepted": float(self._accepted),
                 "gateway_throttled": float(self._throttled),
                 "gateway_disconnects": float(self._disconnects),
                 "gateway_cancel_requests": float(self._cancel_requests),
                 "stream_queue_depth": float(depth),
             }
+            for tier, n in sorted(self._accepted_by_tier.items()):
+                out[f"gateway_accepted_tier_{tier}"] = float(n)
+            return out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -364,8 +427,10 @@ class _Handler(BaseHTTPRequestHandler):
             for key in _FORWARD_KEYS:
                 if body.get(key) is not None:
                     payload[key] = body[key]
+            priority = _merge_slo_fields(body, payload)
             stream = bool(body.get("stream", False))
-            gid, fut, ts = gw.open_request(payload, stream=stream)
+            gid, fut, ts = gw.open_request(payload, stream=stream,
+                                           priority=priority)
         except ServeOverloadedError as e:
             self._respond_json(
                 429, {"error": str(e)},

@@ -270,6 +270,16 @@ class BlockAllocator:
         with self._lock:
             return self._refs.get(block, 0)
 
+    def is_shared(self, block: int) -> bool:
+        """True when ``block`` is visible beyond one request — more than
+        one live reference, or published in the prefix-cache map.  The
+        KV tiering swap path never moves shared blocks: their bytes stay
+        reachable through the prefix cache (or a co-holder), so a
+        preempted holder just drops its reference and re-acquires the
+        chain on resume (falling back to recompute if it was evicted)."""
+        with self._lock:
+            return self._refs.get(block, 0) > 1 or block in self._key_of
+
     def trash_block(self, shard: int = 0) -> int:
         """The reserved never-allocated block absorbing inactive rows'
         garbage scatter for ``shard`` (block 0 in the single-shard case)."""

@@ -41,6 +41,12 @@ VECTOR_FIELDS: Dict[str, Tuple[type, float]] = {
     "step": (np.int32, 0),    # per-slot emitted-token count (seeded keys)
 }
 
+# SLO tier bounds for ``SamplingParams.priority`` — host-side scheduling
+# metadata, deliberately NOT a VECTOR_FIELDS entry: priority and
+# deadline_ms never enter a packed launch vector or a program cache key.
+MIN_PRIORITY = 0
+MAX_PRIORITY = 9
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
@@ -61,6 +67,13 @@ class SamplingParams:
       batch composition, counter interleaving, megastep K, or spec k —
       the seed-per-slot reproducibility invariant.  ``None`` uses the
       engine's shared in-step RNG (base key + launch counter).
+    - ``priority``/``deadline_ms`` are SLO scheduling hints, HOST-side
+      only: ``priority`` is an integer tier in [0, 9] (higher = more
+      important; the scheduler admits high tiers first and preempts low
+      tiers under block pressure), ``deadline_ms`` an optional TTFT
+      target the goodput gauges score against.  Neither field is in
+      ``VECTOR_FIELDS`` — they NEVER enter a packed launch vector or any
+      compiled-program identity, so varying them never recompiles.
     """
 
     temperature: float = 0.0
@@ -69,6 +82,8 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     seed: Optional[int] = None
+    priority: int = 0
+    deadline_ms: Optional[float] = None
 
     def validate(self) -> "SamplingParams":
         if not np.isfinite(self.temperature):
@@ -85,6 +100,19 @@ class SamplingParams:
         if self.seed is not None and not 0 <= int(self.seed) < 2 ** 31:
             raise ValueError(
                 f"seed must be in [0, 2**31) or None, got {self.seed}")
+        if not isinstance(self.priority, int) \
+                or isinstance(self.priority, bool) \
+                or not MIN_PRIORITY <= self.priority <= MAX_PRIORITY:
+            raise ValueError(
+                f"priority must be an int tier in [{MIN_PRIORITY}, "
+                f"{MAX_PRIORITY}], got {self.priority!r}")
+        if self.deadline_ms is not None:
+            d = self.deadline_ms
+            if isinstance(d, bool) or not isinstance(d, (int, float)) \
+                    or not np.isfinite(d) or d <= 0:
+                raise ValueError(
+                    f"deadline_ms must be a positive finite number or "
+                    f"None, got {self.deadline_ms!r}")
         return self
 
     @property

@@ -34,6 +34,8 @@ Examples:
         --reload_poll_s=5 --checkpoint_dir=/tmp/ckpt  # fleet + hot reload
     python serve.py --model=gpt2 --continuous --gateway_port=8080 \
         --max_inflight=32     # HTTP/SSE front door + admission control
+    python serve.py --model=gpt2 --continuous --cache_mode=paged \
+        --slo_scheduling --num_blocks=24    # SLO tiers + KV swap-to-host
 
 SIGTERM (and Ctrl-C) triggers a graceful drain: no new admissions,
 in-flight decodes finish (bounded by --drain_timeout_s), queued requests
@@ -168,6 +170,24 @@ def parse_args(argv=None):
     p.add_argument("--spec_ngram", type=int, default=defaults.spec_ngram,
                    help="speculative decoding: longest history n-gram "
                         "the drafter matches (backs off to 1)")
+    p.add_argument("--slo_scheduling", action="store_true",
+                   default=defaults.slo_scheduling,
+                   help="continuous mode: rank admission by (priority "
+                        "tier, deadline slack, arrival) instead of FIFO; "
+                        "paged mode additionally preempts the lowest "
+                        "tier under block pressure, swapping its KV to "
+                        "host RAM (or recomputing) and resuming when "
+                        "pressure clears")
+    p.add_argument("--swap_min_tokens", type=int,
+                   default=defaults.swap_min_tokens,
+                   help="SLO scheduling: contexts shorter than this "
+                        "always recompute on preemption instead of "
+                        "swapping KV bytes to host")
+    p.add_argument("--starvation_age_s", type=float,
+                   default=defaults.starvation_age_s,
+                   help="SLO scheduling: a waiting request gains one "
+                        "effective priority tier per this many seconds, "
+                        "so low tiers cannot starve forever")
     p.add_argument("--prompt_period", type=int,
                    default=defaults.prompt_period,
                    help="traffic mix: tile each prompt from a motif of "
@@ -229,6 +249,12 @@ def parse_args(argv=None):
                    help="gateway admission control: requests in flight "
                         "past this bound are answered 429 + Retry-After "
                         "instead of queueing unboundedly")
+    p.add_argument("--priority_headroom", type=int,
+                   default=defaults.priority_headroom,
+                   help="gateway: >0 tiers the inflight gate — priority "
+                        "p's limit is max_inflight - (9 - p) * headroom "
+                        "(floored at 1), so under load the lowest tiers "
+                        "shed (429) first (0 = single gate)")
     p.add_argument("--trace_out", default=defaults.trace_out,
                    help="write a Chrome trace-event JSON (per-request "
                         "queue/prefill/decode spans; load in Perfetto) "

@@ -1,0 +1,52 @@
+"""Seeded KV-tiering defects, one per rule family:
+
+- ``SwapLedger`` accumulates ``swapped_bytes`` on its background
+  reclaim thread and reads it from the main (stats) thread with no
+  lock anywhere — the shape of a host-tier residency gauge shared
+  between a reclaimer and the scheduler's ``stats()``.
+  ``cross-thread-race`` must report the write site.
+- ``Preemptor`` pulls a victim's KV block to host with an implicit
+  fetch (``np.asarray(kv)``) inside the hot decode loop — the
+  accidental per-iteration device sync that a swap-out path invites
+  when it skips the explicit ``jax.device_get`` boundary.
+
+Lines are tagged ``# SEED: <rule-id>`` so each rule family only claims
+its own lines when both run over this module.
+"""
+
+import threading
+
+import jax
+import numpy as np
+
+_launch_lock = threading.Lock()
+
+
+class SwapLedger:
+    def __init__(self):
+        self.swapped_bytes = 0
+        self._thread = threading.Thread(target=self._reclaim, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _reclaim(self) -> None:
+        while True:
+            self.swapped_bytes += 4096  # SEED: cross-thread-race
+
+    def resident(self) -> int:
+        return self.swapped_bytes
+
+
+class Preemptor:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, kv: kv)
+
+    def decode_with_swap(self, kv, steps):
+        payloads = []
+        for _ in range(steps):
+            with _launch_lock:
+                kv = self._step(self.params, kv)
+            payloads.append(np.asarray(kv))  # SEED: host-sync
+        return payloads

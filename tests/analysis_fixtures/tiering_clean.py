@@ -1,0 +1,46 @@
+"""Clean twin of ``tiering_bad``: the reclaim-thread write and the
+stats-thread read of ``swapped_bytes`` share one lock, and the swap-out
+payload fetch goes through ONE explicit ``jax.device_get`` point per
+iteration — the sanctioned visible-fetch idiom ``serve/tiering.py``
+itself uses.  Zero findings expected."""
+
+import threading
+
+import jax
+import numpy as np
+
+_launch_lock = threading.Lock()
+
+
+class SwapLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.swapped_bytes = 0
+        self._thread = threading.Thread(target=self._reclaim, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _reclaim(self) -> None:
+        while True:
+            with self._lock:
+                self.swapped_bytes += 4096
+
+    def resident(self) -> int:
+        with self._lock:
+            return self.swapped_bytes
+
+
+class Preemptor:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, kv: kv)
+
+    def decode_with_swap(self, kv, steps):
+        payloads = []
+        for _ in range(steps):
+            with _launch_lock:
+                kv = self._step(self.params, kv)
+            host = jax.device_get(kv)
+            payloads.append(np.asarray(host))
+        return payloads

@@ -64,6 +64,7 @@ class TestSeededFixtures:
         ("blocking", LockOrderRule, "lock-order"),
         ("race", CrossThreadRaceRule, "cross-thread-race"),
         ("gateway", CrossThreadRaceRule, "cross-thread-race"),
+        ("tiering", CrossThreadRaceRule, "cross-thread-race"),
         ("launch", CollectiveLaunchRule, "collective-launch"),
         ("megastep", CollectiveLaunchRule, "collective-launch"),
         ("spec", CollectiveLaunchRule, "collective-launch"),

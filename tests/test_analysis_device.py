@@ -51,6 +51,7 @@ CASES = [
     ("async", UseAfterDonateRule, "use-after-donate"),
     ("async", HostSyncRule, "host-sync"),
     ("gateway", HostSyncRule, "host-sync"),
+    ("tiering", HostSyncRule, "host-sync"),
 ]
 
 

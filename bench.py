@@ -108,7 +108,8 @@ def _spread(rates):
 
 
 _SERVE_ARM_GROUPS = ("chunked", "megastep", "spec", "paged", "fleet",
-                     "prefix", "sampling", "async", "streaming", "slo")
+                     "prefix", "sampling", "async", "async_depth",
+                     "streaming", "slo")
 
 
 def _parse_serve_arms(spec):
@@ -504,6 +505,15 @@ def _serve_bench(flags):
     still keys programs on (temperature, top_k), and counts one
     compiled set per combo.
 
+    The async-depth sweep replays the async arm's steady-state decode
+    wave through the launch ring at depth 1 / 2 / 4 and then reruns the
+    speculative and chunked-prefill compositions async-on:
+    ``async_depth_speedup_d2/d4`` and ``device_idle_fraction_d1/d2/d4``
+    carry the deep-pipeline claim, and the hard asserts pin greedy
+    bit-parity at every depth, zero post-warmup compiles, zero sync
+    fallbacks (spec and chunked prefill no longer flush the ring), and
+    idle fraction at depth >= 2 no worse than depth 1.
+
     The streaming A/B (``_streaming_arm``) drives the paged scheduler
     through ``submit(on_token=...)`` collectors: ``ttfb_p50/p99_ms``
     carry the time-to-first-DELIVERED-token claim, and the cancel
@@ -700,6 +710,22 @@ def _serve_bench(flags):
     # scheduler BEFORE the timed run, so the run itself must not
     # compile anything past warmup.
     mega_auto = dataclasses.replace(async_on, megastep="auto")
+    # Deep-async depth sweep: the SAME steady-state decode wave through
+    # the launch ring at depth 1 (dispatch-then-resolve — launch overlap
+    # only within an iteration), 2 (the classic double buffer) and 4,
+    # plus the two compositions that used to flush the pipeline every
+    # iteration: speculative drafting (now built from the N-1 fetched
+    # view) and chunked prefill (final chunks now ride the ring).  The
+    # ring is a pure dispatch-latency change, so greedy checksums must
+    # match bit-for-bit across every depth.
+    async_depths = (1, 2, 4)
+    depth_cfgs = {d: dataclasses.replace(async_on, async_depth=d)
+                  for d in async_depths}
+    spec_async = dataclasses.replace(spec4, async_decode=True)
+    spec_async4 = dataclasses.replace(spec_async, async_depth=4)
+    async_chunked = dataclasses.replace(
+        async_on, prefill_budget=16 if on_tpu else 4)
+    async_chunked4 = dataclasses.replace(async_chunked, async_depth=4)
     chunk_engine = engine
     if not on_tpu and ({"chunked", "megastep"} & arms):
         chunk_engine = ServeEngine(
@@ -1011,6 +1037,116 @@ def _serve_bench(flags):
                 "megastep_auto_parity": (
                     mega_auto_res["tokens_checksum"]
                     == async_base_runs[0]["tokens_checksum"]),
+            })
+        if "async_depth" in arms:
+            # Depth sweep over the launch ring, measured like the async
+            # arm: interleaved passes, first pass discarded (first-run-
+            # after-compile penalty), best-of-3 per depth.  Hard
+            # asserts: greedy bit-parity across EVERY run at every
+            # depth, zero post-warmup compiles, zero sync fallbacks,
+            # and mean idle fraction at depth >= 2 no worse than the
+            # depth-1 pipeline — deepening the ring must not regress
+            # the overlap it generalizes.
+            depth_runs = {d: [] for d in async_depths}
+            for i in range(4):
+                order = async_depths if i % 2 == 0 else async_depths[::-1]
+                for d in order:
+                    gc.collect()
+                    res = run_serve(depth_cfgs[d], engine=engine)
+                    if i == 0:
+                        continue
+                    depth_runs[d].append(res)
+            best = {d: max(runs, key=lambda r: r["tokens_per_sec"])
+                    for d, runs in depth_runs.items()}
+            ring_ref = depth_runs[1][0]["tokens_checksum"]
+            sweep = [r for runs in depth_runs.values() for r in runs]
+            assert all(r["tokens_checksum"] == ring_ref for r in sweep), (
+                "async ring depth changed greedy output: "
+                + str({d: [r["tokens_checksum"] for r in runs]
+                       for d, runs in depth_runs.items()}))
+            for d, runs in depth_runs.items():
+                for r in runs:
+                    assert r["compile_post_warmup"] == 0, (
+                        f"async depth={d} compiled after warmup: "
+                        f"{r['compile_post_warmup']} compiles")
+                    assert r["async_sync_fallbacks"] == 0, (
+                        f"async depth={d} fell back to sync "
+                        f"{r['async_sync_fallbacks']} times on a "
+                        "greedy single-generation wave")
+            idle = {
+                d: statistics.mean(
+                    r["device_idle_fraction"] for r in runs)
+                for d, runs in depth_runs.items()}
+            for d in async_depths[1:]:
+                assert idle[d] <= idle[1], (
+                    f"depth={d} ring left the device MORE idle than "
+                    f"depth 1: {idle[d]:.4f} vs {idle[1]:.4f}")
+            # Compositions that used to flush the ring.  Spec runs
+            # compare against a sync spec reference (different traffic
+            # than the sweep); the chunked runs replay the sweep's own
+            # traffic, so they join its checksum family directly.
+            # Compile accounting mirrors the spec arm's standing: the
+            # warm pass's 2-token horizon can never draft, so the FIRST
+            # spec-async run pays the chain-verify compile in its timed
+            # window — but the d4 rerun on the same engine must find
+            # every program cached (depth is not a compile key).
+            spec_sync_res = run_serve(spec4, engine=engine)
+            comp = {}
+            for name, cfg, ref, first in (
+                    ("spec_async_d2", spec_async,
+                     spec_sync_res["tokens_checksum"], True),
+                    ("spec_async_d4", spec_async4,
+                     spec_sync_res["tokens_checksum"], False),
+                    ("chunked_async_d2", async_chunked, ring_ref, False),
+                    ("chunked_async_d4", async_chunked4, ring_ref,
+                     False)):
+                gc.collect()
+                res = run_serve(cfg, engine=engine)
+                assert res["tokens_checksum"] == ref, (
+                    f"{name} changed greedy output: "
+                    f"{res['tokens_checksum']} vs {ref}")
+                assert res["async_sync_fallbacks"] == 0, (
+                    f"{name} still flushes the ring: "
+                    f"{res['async_sync_fallbacks']} sync fallbacks")
+                if not first:
+                    assert res["compile_post_warmup"] == 0, (
+                        f"{name} compiled after warmup: "
+                        f"{res['compile_post_warmup']} compiles")
+                comp[name] = res
+            out.update({
+                "async_depths": list(async_depths),
+                "async_depth_parity": True,  # hard-asserted above
+                "async_d1_tokens_per_sec": best[1]["tokens_per_sec"],
+                "async_d2_tokens_per_sec": best[2]["tokens_per_sec"],
+                "async_d4_tokens_per_sec": best[4]["tokens_per_sec"],
+                "async_depth_speedup_d2": round(
+                    best[2]["tokens_per_sec"]
+                    / max(best[1]["tokens_per_sec"], 1e-9), 3),
+                "async_depth_speedup_d4": round(
+                    best[4]["tokens_per_sec"]
+                    / max(best[1]["tokens_per_sec"], 1e-9), 3),
+                "device_idle_fraction_d1": round(idle[1], 4),
+                "device_idle_fraction_d2": round(idle[2], 4),
+                "device_idle_fraction_d4": round(idle[4], 4),
+                "async_ring_depth_avg_d4":
+                    best[4]["async_ring_depth_avg"],
+                "async_fetch_wait_s_d4":
+                    best[4]["async_fetch_wait_s"],
+                "spec_async_parity": True,  # hard-asserted above
+                # The chain-verify program's one-time compile (warm
+                # can't draft at a 2-token horizon); the d4 rerun is
+                # hard-asserted compile-free.
+                "spec_async_compile_first":
+                    comp["spec_async_d2"]["compile_post_warmup"],
+                "spec_async_sync_fallbacks":
+                    comp["spec_async_d4"]["async_sync_fallbacks"],
+                "spec_async_acceptance_rate":
+                    comp["spec_async_d2"]["spec_acceptance_rate"],
+                "chunked_async_parity": True,  # hard-asserted above
+                "chunked_async_sync_fallbacks":
+                    comp["chunked_async_d4"]["async_sync_fallbacks"],
+                "chunked_async_prefill_chunks":
+                    comp["chunked_async_d2"]["prefill_chunks"],
             })
         if "streaming" in arms:
             out.update(_streaming_arm(engine, continuous, block_size))

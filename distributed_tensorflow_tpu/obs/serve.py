@@ -132,6 +132,26 @@ class ServeMonitorHook(Hook):
                     int(s.get("preempted_pending", 0)),
                     s.get("swap_bytes_total", 0.0) / 2**20,
                 )
+            if s.get("async_decode", 0):
+                # Deep async decode: realized ring occupancy against the
+                # configured depth, plus where the remaining stall time
+                # sits — device_idle is the device waiting on the host
+                # (deepen the ring / shrink host work), fetch_wait is
+                # the host waiting on the fetch thread (the overlap's
+                # residual).  Fallbacks climbing means traffic keeps
+                # hitting a sync-only path (seeded sampling, mixed
+                # generations mid-reload).
+                logger.info(
+                    "serve @ %d: async depth=%d ring_avg=%.2f "
+                    "ring_max=%d fallbacks=%d idle=%.3f "
+                    "fetch_wait=%.3fs",
+                    step, int(s.get("async_depth", 0)),
+                    s.get("async_ring_depth_avg", 0.0),
+                    int(s.get("async_ring_depth_max", 0)),
+                    int(s.get("async_sync_fallbacks", 0)),
+                    s.get("device_idle_fraction", 0.0),
+                    s.get("async_fetch_wait_s", 0.0),
+                )
             if s.get("spec_k", 0):
                 # Speculative decoding: drafter yield and verify
                 # amortization — tok/launch > 1 is the win over the

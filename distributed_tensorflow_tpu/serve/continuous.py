@@ -135,32 +135,40 @@ Fleet extensions (``serve/fleet``):
   launches per generated token on repetitive/structured text —
   ``spec_emitted / spec_launches`` tokens per launch against the plain
   path's one.
-- ASYNC DOUBLE-BUFFERED DECODE — ``async_decode=True`` splits the
-  megastep into dispatch and fetch halves and reorders the iteration
-  to host scheduling -> dispatch megastep N+1 -> fetch megastep N, so
-  admission, prefill chunking, and retirement bookkeeping overlap the
-  launch already executing on device instead of serializing behind
-  its fetch.  The donated resident cache makes the chain safe: every
-  launch rebinds the cache in the assignment that donates it, the
-  next dispatch consumes device values (token carry + cache) with no
-  host round-trip, and all host syncs route through ``_fetch_host``
-  (the one sanctioned ``jax.device_get``) — the discipline dttlint's
-  ``use-after-donate``/``host-sync`` rules machine-check.  The cost
-  is ONE iteration of admission lag: a request submitted while
-  megastep N is in flight prefills at N+1 (TTFT unchanged — its first
-  token comes from prefill), rides launch N+1, and its first decoded
-  tokens land at N+2's fetch.  A slot admitted mid-flight has its
-  true last token only on host, so dispatch passes per-slot
-  ``fresh_tokens``/``fresh`` vectors and the scan's first step selects
-  them on device (always passed — zeros when nothing is fresh — so
-  compiled-program identity never depends on admission timing).
-  Paths that need the host view current (speculative drafting,
-  seeded-sampling replay, mixed-generation iterations) flush the
-  in-flight launch and fall back to the sync order for that
-  iteration.  Greedy output is bit-identical async on vs off; the
-  observable win is ``device_idle_fraction`` (share of the window
-  with no launch in flight, from the dispatch/fetch spans) going to
-  ~zero on decode-heavy traffic.
+- DEEP ASYNC DECODE — ``async_decode=True`` splits every launch into
+  dispatch and fetch halves and runs a bounded LAUNCH RING
+  (``async_depth=D``, default 2 — the classic double buffer): each
+  iteration dispatches launch N, then resolves the oldest ring
+  records until at most D-1 stay in flight, so the device runs up to
+  D launches ahead of the host view and admission, prefill chunking,
+  and retirement bookkeeping all overlap executing compute.  Records
+  resolve strictly in launch order; a dedicated FETCH THREAD performs
+  the ``jax.device_get`` half off the loop thread (a device_get is
+  not a launch — it needs no launch lock), handing host arrays back
+  through each record's Future, so fetch latency overlaps the next
+  iteration's host scheduling too.  The donated resident cache makes
+  the chain safe: every launch rebinds the cache in the assignment
+  that donates it, the next dispatch consumes device values (token
+  carry + cache) with no host round-trip, and all host syncs route
+  through ``_fetch_host`` (the one sanctioned ``jax.device_get``) —
+  the discipline dttlint's ``use-after-donate``/``host-sync`` rules
+  machine-check.  The cost is up to D-1 iterations of delivery lag: a
+  request submitted while launch N is in flight prefills at N+1 (its
+  final chunk's first-token fetch rides the ring as a deferred
+  record), and its first decoded tokens land when that record
+  resolves.  A slot admitted mid-flight has its true last token only
+  on host, so dispatch passes per-slot ``fresh_tokens``/``fresh``
+  vectors and the launch's first step selects them on device.
+  Speculative decoding COMPOSES: drafts build from the stale fetched
+  view and a chain-verify launch scores them against the
+  device-resident carry, so staleness costs acceptance length, never
+  a token.  Only seeded-sampling and mixed-generation iterations
+  still drain the ring and fall back to the sync order
+  (``async_sync_fallbacks`` counts them).  Greedy output is
+  bit-identical async on vs off at every depth; the observable win is
+  ``device_idle_fraction`` (share of the window with no launch in
+  flight, from the dispatch/fetch spans) going to ~zero on
+  decode-heavy traffic.
 """
 
 from __future__ import annotations
@@ -168,6 +176,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import logging
+import queue
 import threading
 import time
 from concurrent.futures import Future
@@ -277,6 +286,10 @@ def _continuous_instruments(registry=None):
             "Fraction of the decode window the device sat with NO "
             "launch in flight (gap between a fetch completing and the "
             "next dispatch) — async decode's target"),
+        "ring_depth": r.gauge(
+            "dtt_serve_async_ring_depth",
+            "Launches in the async ring right now (post-dispatch "
+            "occupancy; bounded by --async_depth)"),
         "ttfb": r.histogram(
             "dtt_serve_ttfb_seconds",
             "Submit to first token DELIVERED off the loop thread "
@@ -421,7 +434,14 @@ class _InflightMegastep:
     which requests were decoding (and how far along each was) at
     dispatch, and the per-slot token counts the dispatch already charged
     (``pending``) so the next dispatch's horizons exclude tokens that
-    are still in flight."""
+    are still in flight.
+
+    Records live in the scheduler's launch ring (``async_depth`` deep)
+    and resolve strictly in launch order.  The fetch thread performs the
+    ``jax.device_get`` half and hands the HOST arrays back through
+    ``fetched`` — the one cross-thread handoff; every plain field is
+    written at construction on the loop thread and only read afterwards.
+    """
 
     # [(slots, toks_dev, steps_dev)] — one entry per live generation.
     launches: List[Tuple[List[int], Any, Any]]
@@ -429,15 +449,67 @@ class _InflightMegastep:
     # self._active; membership frozen at dispatch).
     decoding: Dict[int, Any]
     # slot -> prior len(req.tokens) at dispatch (columns before this
-    # launch's output).
+    # launch's output — includes every OLDER ring record's pending).
     base_len: Dict[int, int]
     # slot -> tokens this launch can still emit (min(K, horizon)); the
-    # NEXT dispatch subtracts these from its own horizons.
+    # NEXT dispatch subtracts these (summed over the whole ring) from
+    # its own horizons.
     pending: Dict[int, int]
     steps: int                       # the K this launch compiled with
     dispatch_t: float                # time.monotonic() at dispatch
     seq: int                         # _launch_seq at dispatch
     clock_dev: Any = None            # on-device iteration clock output
+    # Device handles the fetch thread resolves (set at construction):
+    # (launches, clock_dev) — one ``jax.device_get`` over the pytree.
+    fetch_payload: Any = None
+    # True once handed to the fetch thread; resolution then reads
+    # ``fetched`` instead of fetching inline.
+    enqueued: bool = False
+    # Resolved by the fetch thread to (host pytree, fetch-done time).
+    fetched: Future = dataclasses.field(default_factory=Future)
+
+
+@dataclasses.dataclass
+class _InflightSpec:
+    """One dispatched-but-not-fetched speculative verify launch (async
+    decode + ``spec_k``).  Drafts were built from the N-1 fetched host
+    view — staleness only costs acceptance, never correctness: the
+    verify scores against the device-resident carry, so the emitted
+    targets are the exact sequential tokens regardless of what the host
+    had seen at draft time."""
+
+    # [(slots, targets_dev, accepted_dev)] — single generation only
+    # (mixed generations fall back to sync).
+    launches: List[Tuple[List[int], Any, Any]]
+    decoding: Dict[int, Any]
+    # slot -> WORST-CASE tokens this launch may emit (draft_len + 1,
+    # clamped to the horizon); later dispatches budget against it and
+    # the resolve trues the host view up.
+    pending: Dict[int, int]
+    draft_lens: Dict[int, int]       # slot -> real (unpadded) draft len
+    k: int                           # the spec_k the program compiled with
+    dispatch_t: float
+    seq: int
+    clock_dev: Any = None
+    fetch_payload: Any = None
+    enqueued: bool = False
+    fetched: Future = dataclasses.field(default_factory=Future)
+
+
+@dataclasses.dataclass
+class _InflightPrefill:
+    """One final prefill chunk whose first-token fetch was deferred into
+    the launch ring (async decode): the chunk's launch interleaves with
+    in-flight decode fetches instead of serializing the loop thread on a
+    blocking ``device_get`` mid-iteration.  The slot stays out of the
+    decode-active set (``req.tokens`` empty) until this resolves."""
+
+    req: Any                         # the _SlotRequest mid-handoff
+    dispatch_t: float                # final chunk launch time
+    pending: Dict[int, int] = dataclasses.field(default_factory=dict)
+    fetch_payload: Any = None        # tok_dev — (1,) first decoded token
+    enqueued: bool = False
+    fetched: Future = dataclasses.field(default_factory=Future)
 
 
 @dataclasses.dataclass
@@ -493,6 +565,7 @@ class ContinuousScheduler:
         prefill_budget: int = 0,
         megastep: Union[int, str] = 1,
         async_decode: bool = False,
+        async_depth: int = 2,
         spec_k: Optional[int] = None,
         spec_ngram: int = 3,
         slo_scheduling: bool = False,
@@ -541,6 +614,11 @@ class ContinuousScheduler:
             raise ValueError(
                 f"megastep must be >= 1 (1 = one decode iteration per "
                 f"compiled launch, the classic path), got {megastep}")
+        if async_depth < 1:
+            raise ValueError(
+                f"async_depth must be >= 1 (launches the ring may hold "
+                f"in flight; 1 = dispatch-then-resolve, 2 = the classic "
+                f"double buffer), got {async_depth}")
         if spec_k is not None and spec_k < 1:
             raise ValueError(
                 f"spec_k must be >= 1 when set (None/unset disables "
@@ -569,6 +647,7 @@ class ContinuousScheduler:
         self.engine = engine
         self.megastep = int(megastep)
         self.async_decode = bool(async_decode)
+        self.async_depth = int(async_depth)
         self.spec_k = int(spec_k) if spec_k is not None else 0
         self.spec_ngram = int(spec_ngram)
         self.prefill_budget = int(prefill_budget)
@@ -694,10 +773,28 @@ class ContinuousScheduler:
         # dispatch merges these rows from ``_last_tok`` ON DEVICE via the
         # engine's fresh-row mask instead of round-tripping the carry.
         self._fresh = np.zeros((self.num_slots,), bool)
-        # The in-flight megastep launch (async mode): dispatched but not
-        # yet fetched.  Exactly zero or one — double buffering, not a
-        # queue.
-        self._inflight: Optional[_InflightMegastep] = None
+        # The in-flight launch ring (async mode): dispatched-but-not-
+        # resolved records, oldest first, resolved strictly in launch
+        # order.  At most ``async_depth`` records sit in the ring right
+        # after a dispatch; the resolve loop then drains it back below
+        # the depth, so ``async_depth - 1`` unresolved launches persist
+        # across iterations (depth 2 = the classic double buffer).
+        # Loop-thread state; records are handed to the fetch thread by
+        # reference (their Futures are the only cross-thread channel).
+        self._ring: "collections.deque[Any]" = collections.deque()
+        # Dedicated fetch thread: performs the ``jax.device_get`` half
+        # off the loop thread so fetch latency overlaps the NEXT
+        # iteration's host scheduling.  Started lazily at the first
+        # async dispatch; a None sentinel shuts it down in close().
+        self._fetch_q: "queue.Queue[Any]" = queue.Queue()
+        self._fetch_thread: Optional[threading.Thread] = None
+        # Ring telemetry (under _lock): sync fallbacks taken while
+        # async_decode was on, ring occupancy per dispatch, and loop-
+        # thread seconds spent blocked on a fetch-thread result (the
+        # residual fetch latency the overlap did NOT hide).
+        self._async_fallbacks = 0
+        self._ring_depth_hist: collections.Counter = collections.Counter()
+        self._fetch_wait_s = 0.0
         # On-device iteration clock: cumulative inner decode steps, one
         # int32 carried launch to launch so K>1 TPOT stamps are anchored
         # to real device progress.  ``_device_clock`` is the host mirror,
@@ -1178,6 +1275,22 @@ class ContinuousScheduler:
                 "async_decode": 1.0 if self.async_decode else 0.0,
                 "device_clock": float(self._device_clock),
                 "device_idle_fraction": self._idle_fraction_locked(),
+                # The launch ring: configured depth, iterations that
+                # fell back to a sync path (spec/prefill compose now, so
+                # steady-state async traffic should hold this at zero),
+                # realized ring occupancy at dispatch, and loop-thread
+                # seconds spent blocked on the fetch thread (residual
+                # fetch latency the overlap did NOT hide).
+                "async_depth": float(self.async_depth),
+                "async_sync_fallbacks": float(self._async_fallbacks),
+                "async_ring_depth_avg": (
+                    sum(d * c for d, c in self._ring_depth_hist.items())
+                    / sum(self._ring_depth_hist.values())
+                    if self._ring_depth_hist else 0.0),
+                "async_ring_depth_max": float(
+                    max(self._ring_depth_hist)
+                    if self._ring_depth_hist else 0),
+                "async_fetch_wait_s": float(self._fetch_wait_s),
                 "spec_k": float(self.spec_k),
                 "spec_launches": float(self._spec_launches),
                 "spec_drafted": float(self._spec_drafted),
@@ -1230,6 +1343,13 @@ class ContinuousScheduler:
             self._obs_registry.unregister_stats(self.obs_namespace)
         if self._thread.is_alive():
             self._thread.join(timeout)
+        if self._fetch_thread is not None:
+            # The loop's exit path drained the ring, so every queued
+            # record has been resolved; the sentinel wakes the worker
+            # to exit.  (Loop-death leftovers resolve into Futures no
+            # one reads — harmless — before the sentinel is reached.)
+            self._fetch_q.put(None)
+            self._fetch_thread.join(timeout)
         with self._cond:
             leftover = (list(self._queue) + list(self._active.values())
                         + list(self._preempted))
@@ -1288,7 +1408,7 @@ class ContinuousScheduler:
                    and not self._queue
                    and not self._preempted
                    and self._pending_gen is None
-                   and self._inflight is None):
+                   and not self._ring):
                 self._cond.wait()
             stopped = self._stopped
             cancels = ([] if stopped else
@@ -1402,7 +1522,7 @@ class ContinuousScheduler:
                 "host_sched", cat="serve", tid=0,
                 start=host_t0, end=time.monotonic(),
                 args={"admitted": len(admits),
-                      "inflight": self._inflight is not None})
+                      "inflight": len(self._ring)})
         if refill:
             # Megastep admission alignment: a K-step launch pins
             # its rows for K iterations, so a request that missed
@@ -1969,7 +2089,27 @@ class ContinuousScheduler:
             req.next_prefill_offset = off + chunk
             req.prefill_chunks += 1
             first_decoded = False
-            if final:
+            deferred = final and self.async_decode
+            if deferred:
+                # Defer the first-token fetch into the launch ring: the
+                # chunk's launch interleaves with in-flight decode
+                # fetches instead of blocking the loop mid-iteration.
+                # The slot stays OUT of the decode-active set
+                # (``req.tokens`` empty) until the resolve lands its
+                # token, so no decode launch dispatches it early.
+                rec = _InflightPrefill(
+                    req=req, dispatch_t=chunk_start, fetch_payload=tok_dev)
+                self._enqueue_fetch(rec)
+                self._ring.append(rec)
+                with self._lock:
+                    self._ring_depth_hist[len(self._ring)] += 1
+                    self._obs["ring_depth"].set(len(self._ring))
+                # The depth bound applies to deferred chunks too: several
+                # slots finishing prefill in one iteration must not stack
+                # the ring past what the flag promises.
+                while len(self._ring) >= self.async_depth:
+                    self._resolve_next()
+            elif final:
                 tok = int(self._fetch_host(tok_dev)[0])
                 now = time.monotonic()
                 # A recompute-resumed request already stamped its TTFT
@@ -1980,13 +2120,7 @@ class ContinuousScheduler:
                 req.last_token_at = now
                 req.tokens.append(tok)
                 self._last_tok[req.slot, 0] = tok
-                if self.async_decode:
-                    # Keep the device carry (a launch may be in flight);
-                    # the next dispatch merges this row from the host
-                    # vector on device via the fresh-row mask.
-                    self._fresh[req.slot] = True
-                else:
-                    self._dev_last_tok = None  # host vector is newer
+                self._dev_last_tok = None  # host vector is newer
                 self._register_prefix(req)
                 self._emit_tokens(req)
             if self._tracer.enabled:
@@ -2015,11 +2149,14 @@ class ContinuousScheduler:
                 if final:
                     self._prefilling -= 1
                     if first_decoded:
+                        # Deferred chunks observe TTFT at their ring
+                        # resolve instead — when the token actually
+                        # became host-visible.
                         self._obs["ttft"].observe(
                             req.first_token_at - req.submitted)
                 self._obs["prefilling_slots"].set(self._prefilling)
                 self._obs["prefill_backlog"].set(self._prefill_backlog)
-            if final:
+            if final and not deferred:
                 logger.debug(
                     "slot %d finished prefill (prompt %d, %d chunk(s), "
                     "ttft %.1fms)", req.slot, len(req.prompt),
@@ -2054,20 +2191,41 @@ class ContinuousScheduler:
         megastep — so a degenerate k=0 verify program is never built or
         cached.
 
-        With ``async_decode`` the iteration is double-buffered: dispatch
-        megastep N+1 BEFORE fetching megastep N, so the device starts
-        the next launch while the host resolves the previous one (and
-        the next iteration's admission/prefill overlaps this launch's
-        compute).  Traffic the stale-by-one host view cannot serve
-        (``_needs_sync``) falls back to the synchronous paths after
-        flushing the in-flight launch."""
+        With ``async_decode`` the iteration runs the launch RING:
+        dispatch iteration N's launch, append it, then resolve the
+        oldest record(s) until at most ``async_depth - 1`` stay in
+        flight — so the device runs up to ``async_depth`` launches
+        ahead of the host view (depth 2 = the classic double buffer;
+        depth 1 = dispatch-then-resolve).  Speculative iterations
+        dispatch a chain-verify launch drafted from the stale fetched
+        view, and deferred final prefill chunks ride the same ring, so
+        neither flushes it anymore.  Traffic the stale host view cannot
+        serve (``_needs_sync``) still falls back to the synchronous
+        paths after draining the ring."""
         if self.async_decode and not self._needs_sync():
-            rec = self._megastep_dispatch()
-            prev, self._inflight = self._inflight, None
-            if prev is not None:
-                self._megastep_fetch(prev)
-            self._inflight = rec
+            rec = None
+            if self.spec_k:
+                rec = self._spec_dispatch_async()
+            if rec is None:
+                rec = self._megastep_dispatch()
+            if rec is None:
+                # Nothing dispatchable (every live horizon is already in
+                # flight, or no row decodes yet): resolve ONE record so
+                # the loop still makes progress toward the host view.
+                if self._ring:
+                    self._resolve_next()
+                return
+            self._enqueue_fetch(rec)
+            self._ring.append(rec)
+            with self._lock:
+                self._ring_depth_hist[len(self._ring)] += 1
+                self._obs["ring_depth"].set(len(self._ring))
+            while len(self._ring) >= self.async_depth:
+                self._resolve_next()
             return
+        if self.async_decode:
+            with self._lock:
+                self._async_fallbacks += 1
         self._flush_inflight()
         if self._fresh.any():
             # Collapse to the sync invariant: with every launch resolved
@@ -2210,8 +2368,10 @@ class ContinuousScheduler:
         generation groups ride the already-merged carry), so the carry
         chain never round-trips the host.
         """
-        prev = self._inflight
-        prev_pending = prev.pending if prev is not None else {}
+        prev_pending: Dict[int, int] = {}
+        for r in self._ring:
+            for slot, n in r.pending.items():
+                prev_pending[slot] = prev_pending.get(slot, 0) + n
         decoding = self._decode_snapshot()
         with self._lock:
             K = self.megastep
@@ -2297,7 +2457,12 @@ class ContinuousScheduler:
             base_len={s: len(decoding[s].tokens) + prev_pending.get(s, 0)
                       for s in active_slots},
             pending=pending, steps=K, dispatch_t=dispatch_t, seq=seq,
-            clock_dev=clock)
+            clock_dev=clock,
+            # Device handles only — slots stay host-side in ``launches``
+            # (fetched lists round-trip as unhashable 0-d arrays).
+            fetch_payload=([(toks_dev, steps_dev)
+                            for _, toks_dev, steps_dev in launches],
+                           clock))
 
     def _megastep_fetch(self, rec: _InflightMegastep) -> None:
         """Fetch half: resolve a dispatched megastep — ONE (num_slots, K)
@@ -2320,11 +2485,11 @@ class ContinuousScheduler:
         per inner step, not an equal share of the host's observation
         gap (which, async, includes a whole iteration of host work)."""
         K = rec.steps
-        fetched = [(slots, self._fetch_host(toks_dev),
-                    int(self._fetch_host(steps_dev)))
-                   for slots, toks_dev, steps_dev in rec.launches]
-        clock_now = int(self._fetch_host(rec.clock_dev))
-        fetch_done = time.monotonic()
+        (outs_host, clock_host), fetch_done = self._rec_result(rec)
+        fetched = [(slots, toks, int(steps))
+                   for (slots, _, _), (toks, steps)
+                   in zip(rec.launches, outs_host)]
+        clock_now = int(clock_host)
         if self._tracer.enabled:
             self._tracer.add_span(
                 "fetch", cat="serve", tid=0,
@@ -2381,25 +2546,90 @@ class ContinuousScheduler:
         return jax.device_get(value)
 
     def _flush_inflight(self) -> None:
-        """Resolve the in-flight launch, if any.  The barrier for every
-        path that needs the host view current: mode switches back to
-        sync, autotune re-picking K, drain, and loop exit."""
-        rec, self._inflight = self._inflight, None
-        if rec is not None:
+        """Resolve EVERY in-flight launch, oldest first.  The barrier
+        for every path that needs the host view current: mode switches
+        back to sync, autotune re-picking K, cancellation, drain, and
+        loop exit."""
+        while self._ring:
+            self._resolve_next()
+
+    def _resolve_next(self) -> None:
+        """Resolve the OLDEST in-flight ring record — launch order is
+        resolve order, unconditionally, so admission/retire bookkeeping
+        trues up in exactly the order the device ran."""
+        rec = self._ring.popleft()
+        with self._lock:
+            self._obs["ring_depth"].set(len(self._ring))
+        if isinstance(rec, _InflightPrefill):
+            self._prefill_fetch(rec)
+        elif isinstance(rec, _InflightSpec):
+            self._spec_fetch(rec)
+        else:
             self._megastep_fetch(rec)
 
+    def _rec_result(self, rec) -> Tuple[Any, float]:
+        """A ring record's host payload plus its fetch-done timestamp.
+
+        Enqueued records resolve on the fetch thread: block on the
+        record's Future — accounting the wait, the residual fetch
+        latency the overlap did NOT hide — and re-raise any device
+        error here on the loop thread, where the loop-death handler
+        fails the outstanding request futures.  Records never handed to
+        the fetch thread fetch inline (the flush paths on a
+        just-constructed record).  Loop thread only; never called while
+        holding the scheduler lock (the Future wait would invert the
+        lock order against the fetch thread's result hand-back)."""
+        if rec.enqueued:
+            t0 = time.monotonic()
+            out, t_done = rec.fetched.result()
+            with self._lock:
+                self._fetch_wait_s += time.monotonic() - t0
+            return out, t_done
+        return self._fetch_host(rec.fetch_payload), time.monotonic()
+
+    def _enqueue_fetch(self, rec) -> None:
+        """Hand a just-dispatched record to the fetch thread (lazily
+        started — sync schedulers never pay for it)."""
+        if self._fetch_thread is None:
+            self._fetch_thread = threading.Thread(
+                target=self._fetch_worker,
+                name=self._thread.name + "-fetch", daemon=True)
+            self._fetch_thread.start()
+        rec.enqueued = True
+        self._fetch_q.put(rec)
+
+    def _fetch_worker(self) -> None:
+        """Fetch-thread main: one blocking ``jax.device_get`` per ring
+        record, strictly in launch order (the queue preserves it).  The
+        device executes launches in dispatch order, so waiting on record
+        N's outputs never races record N+1's compute.  A device_get is
+        NOT a launch — it joins the device stream read-only — so this
+        thread never takes the engine launch lock; the record's Future
+        is its only channel back to the loop thread.  Errors resolve the
+        Future exceptionally and re-raise at the loop's resolve."""
+        while True:
+            rec = self._fetch_q.get()
+            if rec is None:
+                return
+            try:
+                rec.fetched.set_result(
+                    (self._fetch_host(rec.fetch_payload),
+                     time.monotonic()))
+            except BaseException as e:  # noqa: BLE001 — rethrown at resolve
+                rec.fetched.set_exception(e)
+
     def _needs_sync(self) -> bool:
-        """Rows the double-buffered dispatch cannot serve from a
-        one-iteration-stale host view: speculative decoding drafts from
-        ``req.tokens`` (incomplete while in flight), multiple live
-        generations chain grouped launches (the fetch order would
-        interleave with the next dispatch), and SEEDED sampling folds
-        ``len(req.tokens)`` into its per-row key (a stale step would
-        replay keys).  Greedy rows ignore the RNG entirely and unseeded
-        sampled rows draw from the global per-launch counter — fresh
-        every dispatch — so both stay async-safe."""
-        if self.spec_k:
-            return True
+        """Rows the ring's stale-by-up-to-D-iterations host view cannot
+        serve: multiple live generations chain grouped launches (the
+        fetch order would interleave with the next dispatch), and SEEDED
+        sampling folds ``len(req.tokens)`` into its per-row key (a stale
+        step would replay keys).  Greedy rows ignore the RNG entirely
+        and unseeded sampled rows draw from the global per-launch
+        counter — fresh every dispatch — so both stay async-safe.
+        Speculative decoding COMPOSES now: drafts come from the stale
+        fetched view (staleness only costs acceptance length) and the
+        chain verify scores against the device-resident carry, so the
+        emitted targets stay exactly the sequential tokens."""
         with self._lock:
             reqs = [r for r in self._active.values() if r.tokens]
         gens = set()
@@ -2470,16 +2700,21 @@ class ContinuousScheduler:
             "megastep autotune: froze K=%d (dispatch %.3f ms, inner "
             "step %.3f ms)", k, a * 1e3, b * 1e3)
 
-    def _draft_for(self, req: _SlotRequest) -> Optional[np.ndarray]:
+    def _draft_for(self, req: _SlotRequest,
+                   inflight: int = 0) -> Optional[np.ndarray]:
         """n-gram prompt-lookup drafter: match the request's last n tokens
         (n from ``spec_ngram`` down to 1) against earlier occurrences in
         its OWN prompt + generated history and propose the continuation
         after the LATEST match — up to ``spec_k`` tokens, clamped so the
-        drafts plus the guaranteed bonus token never exceed the horizon.
-        Pure host-side numpy; returns None when nothing matches (or the
-        horizon leaves no room for even one draft), which is what lets a
-        draft-less iteration fall through to the plain step."""
-        k = min(self.spec_k, req.max_new_tokens - len(req.tokens) - 1)
+        drafts plus the guaranteed bonus token never exceed the horizon
+        (MINUS ``inflight`` tokens other launches may still emit — the
+        async ring budgets worst case, so under-drafting is the safe
+        side).  Pure host-side numpy; returns None when nothing matches
+        (or the horizon leaves no room for even one draft), which is
+        what lets a draft-less iteration fall through to the plain
+        step."""
+        k = min(self.spec_k,
+                req.max_new_tokens - len(req.tokens) - inflight - 1)
         if k < 1:
             return None
         if req.tokens:
@@ -2656,6 +2891,222 @@ class ContinuousScheduler:
             if saved > 0:
                 self._obs["megastep_amortized"].inc(saved)
         return True
+
+    def _spec_dispatch_async(self) -> Optional[_InflightSpec]:
+        """Dispatch half of an ASYNC speculative iteration: draft every
+        live row from the stale fetched view, launch ONE chain-verify
+        program (single live generation — ``_needs_sync`` already routed
+        mixed generations to sync), and return the ring record.  Returns
+        None when no slot drafted, so the caller falls through to the
+        megastep dispatch and a degenerate k=0 verify is never built.
+
+        Horizons budget WORST CASE against the ring (draft_len + 1 per
+        in-flight spec launch): acceptance below the worst case only
+        means this dispatch under-drafts — the conservative side, never
+        an overrun past ``max_new_tokens``.  RNG counters: the launch
+        reserves ``spec_k + 1`` counters like the sync path but never
+        refunds the unconsumed tail (the consumed count is unknown until
+        resolve, and later launches have drawn their own ranges by
+        then).  Greedy rows ignore counters entirely — the parity
+        surface — and unseeded sampled rows remain distribution-exact,
+        same as the sync multi-launch case."""
+        prev_pending: Dict[int, int] = {}
+        for r in self._ring:
+            for slot, n in r.pending.items():
+                prev_pending[slot] = prev_pending.get(slot, 0) + n
+        decoding = self._decode_snapshot()
+        drafts: Dict[int, np.ndarray] = {}
+        active_slots: List[int] = []
+        pending: Dict[int, int] = {}
+        for slot in sorted(decoding):
+            req = decoding[slot]
+            inflight = prev_pending.get(slot, 0)
+            left = req.max_new_tokens - len(req.tokens) - inflight
+            if left <= 0:
+                continue  # the rest of the horizon is already in flight
+            active_slots.append(slot)
+            d = self._draft_for(req, inflight)
+            if d is not None:
+                drafts[slot] = d
+            # Draft-less rows still ride the launch (their bonus target
+            # advances them one token, like the sync verify).
+            pending[slot] = (d.size if d is not None else 0) + 1
+        if not drafts:
+            return None  # fall through: never build a k=0 verify
+        K = self.spec_k
+        dispatch_t = time.monotonic()
+        tokens_in = np.zeros((self.num_slots, K + 1), np.int32)
+        # Column 0 is dead weight in chain mode — the device substitutes
+        # the carry — but fill it so the host array stays well-formed.
+        tokens_in[:, 0] = self._last_tok[:, 0]
+        draft_lens = np.zeros((self.num_slots,), np.int32)
+        for slot, d in drafts.items():
+            tokens_in[slot, 1:1 + d.size] = d
+            draft_lens[slot] = d.size
+        for slot in active_slots:
+            # Cover every position this launch may write (carry target +
+            # accepted drafts) PAST the worst-case in-flight tokens,
+            # clamped to the admission reservation.
+            req = decoding[slot]
+            self._ensure_blocks(req, spec_coverage(
+                req.base_prompt_len,
+                len(req.tokens) + prev_pending.get(slot, 0),
+                int(draft_lens[slot]), req.max_new_tokens))
+        active = np.zeros((self.num_slots,), bool)
+        active[active_slots] = True
+        # Same carry/fresh/clock chaining contract as the megastep
+        # dispatch: device-resident when a launch already ran, host
+        # vectors otherwise.
+        carry = (self._dev_last_tok if self._dev_last_tok is not None
+                 else self._last_tok[:, 0])
+        fresh = fresh_tokens = None
+        if self._dev_last_tok is not None and self._fresh.any():
+            fresh = self._fresh.copy()
+            fresh_tokens = self._last_tok[:, 0].copy()
+        if self._dev_clock is not None:
+            clock = self._dev_clock
+        else:
+            with self._lock:
+                clock = np.int32(self._device_clock)
+        samp = self._sampling_vector(decoding)
+        (targets_dev, accepted_dev, carry_out, clock_out, self._cache,
+         self._counts) = self.engine.verify_slots(
+            self._cache, tokens_in, active, draft_lens,
+            sampling=samp, counts=self._counts,
+            counter=self._next_counter(K + 1),
+            params=decoding[active_slots[0]].gen.params,
+            chain=True, carry=carry, fresh_tokens=fresh_tokens,
+            fresh=fresh, clock=clock, **self._paged_call_kwargs())
+        launches = [(active_slots, targets_dev, accepted_dev)]
+        self._dev_last_tok = carry_out
+        self._dev_clock = clock_out
+        self._fresh[:] = False
+        with self._lock:
+            self._iterations += 1
+            self._occupancy_sum += len(active_slots)
+            self._last_occupancy = len(active_slots)
+            self._note_dispatch_locked(dispatch_t)
+            seq = self._launch_seq
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "dispatch", cat="serve", tid=0,
+                start=dispatch_t, end=time.monotonic(),
+                args={"active_slots": len(active_slots), "spec_k": K,
+                      "drafted": int(draft_lens.sum())})
+        return _InflightSpec(
+            launches=launches, decoding=decoding, pending=pending,
+            draft_lens={s: int(draft_lens[s]) for s in active_slots},
+            k=K, dispatch_t=dispatch_t, seq=seq, clock_dev=clock_out,
+            fetch_payload=([(targets_dev, accepted_dev)], clock_out))
+
+    def _spec_fetch(self, rec: _InflightSpec) -> None:
+        """Fetch half: resolve a dispatched chain-verify launch — the
+        same ``req.done()`` trim walk, TPOT stamping, and boundary
+        retirement as the sync spec path, one ring position later.  A
+        slot that retired at an earlier fetch is skipped whole (zombie
+        tail — the megastep fetch's contract)."""
+        (outs_host, clock_host), fetch_done = self._rec_result(rec)
+        fetched = [(slots, targets, accepted)
+                   for (slots, _, _), (targets, accepted)
+                   in zip(rec.launches, outs_host)]
+        clock_now = int(clock_host)
+        if self._tracer.enabled:
+            self._tracer.add_span(
+                "fetch", cat="serve", tid=0,
+                start=rec.dispatch_t, end=fetch_done,
+                args={"spec_k": rec.k, "launches": len(rec.launches)})
+        gaps: List[float] = []
+        emitted_per_slot: List[int] = []
+        appended = 0
+        accepted_total = 0
+        for slots, targets, accepted in fetched:
+            for slot in slots:
+                req = rec.decoding[slot]
+                if req.finished_at is not None:
+                    continue  # retired at an earlier fetch: zombie tail
+                acc = int(accepted[slot])
+                n = 0
+                for j in range(acc + 1):
+                    if req.done():
+                        break  # eos mid-acceptance trims the tail
+                    req.tokens.append(int(targets[slot, j]))
+                    n += 1
+                appended += n
+                accepted_total += min(acc, n)
+                emitted_per_slot.append(n)
+                if n:
+                    self._last_tok[slot, 0] = req.tokens[-1]
+                    if req.last_token_at is not None:
+                        per = ((fetch_done - req.last_token_at)
+                               * 1000.0 / n)
+                        gaps.extend([per] * n)
+                    req.last_token_at = fetch_done
+                    self._emit_tokens(req)
+                if req.done():
+                    self._retire(req)
+        drafted_total = sum(rec.draft_lens.values())
+        with self._lock:
+            self._device_clock = clock_now
+            self._tpot_gaps_ms.extend(gaps)
+            # A verify launch IS a decode launch, same as the sync path.
+            self._megastep_launches += len(rec.launches)
+            self._megastep_tokens += appended
+            self._spec_launches += len(rec.launches)
+            self._spec_drafted += drafted_total
+            self._spec_accepted += accepted_total
+            self._spec_emitted += appended
+            self._obs["spec_drafted"].inc(drafted_total)
+            self._obs["spec_accepted"].inc(accepted_total)
+            if drafted_total:
+                self._obs["spec_accept_rate"].observe(
+                    accepted_total / drafted_total)
+            for n in emitted_per_slot:
+                if n:
+                    self._obs["spec_accepted_len"].observe(n)
+            saved = appended - len(rec.launches)
+            if saved > 0:
+                self._obs["megastep_amortized"].inc(saved)
+            self._note_fetch_done_locked(rec.seq, fetch_done)
+            self._obs["device_idle"].set(self._idle_fraction_locked())
+
+    def _prefill_fetch(self, rec: _InflightPrefill) -> None:
+        """Resolve a deferred final prefill chunk: the request's first
+        decoded token lands HERE — at its ring position — instead of at
+        a blocking mid-iteration device_get that would have waited out
+        every launch queued ahead of it on the device stream.  TTFT and
+        TTFB stamp at resolve (when the token actually became host-
+        visible); the slot joins the decode-active set at the NEXT
+        dispatch via the fresh-row merge."""
+        host, fetch_done = self._rec_result(rec)
+        req = rec.req
+        if req.finished_at is not None:
+            return  # retired while the chunk was in flight
+        tok = int(host[0])
+        # A recompute-resumed request already stamped its TTFT on its
+        # first admission — never restamp.
+        first_decoded = req.first_token_at is None
+        if first_decoded:
+            req.first_token_at = fetch_done
+        req.last_token_at = fetch_done
+        req.tokens.append(tok)
+        self._last_tok[req.slot, 0] = tok
+        # Keep the device carry (launches may be in flight); the next
+        # dispatch merges this row from the host vector on device via
+        # the fresh-row mask.
+        self._fresh[req.slot] = True
+        self._register_prefix(req)
+        self._emit_tokens(req)
+        if first_decoded:
+            with self._lock:
+                self._obs["ttft"].observe(
+                    req.first_token_at - req.submitted)
+        logger.debug(
+            "slot %d finished prefill (prompt %d, %d chunk(s), "
+            "ttft %.1fms)", req.slot, len(req.prompt),
+            req.prefill_chunks,
+            (req.first_token_at - req.submitted) * 1e3)
+        if req.done():  # max_new_tokens == 1 or instant eos
+            self._retire(req)
 
     def _next_counter(self, count: int = 1) -> int:
         """Reserve ``count`` consecutive in-step RNG counters and return

@@ -114,11 +114,14 @@ class ServeArgs:
     # the chosen K for the run itself, so compiled-program identity
     # stays stable (no post-warmup recompiles).
     megastep: Any = 1
-    # Async double-buffered decode: dispatch megastep N+1 before
-    # fetching megastep N's tokens, so admission/prefill/retirement run
-    # while the device computes.  Costs one iteration of admission lag;
-    # greedy output stays bit-identical on vs off.
+    # Deep async decode: dispatch each launch before resolving the
+    # previous ones, so admission/prefill/retirement run while the
+    # device computes.  Costs up to async_depth - 1 iterations of
+    # delivery lag; greedy output stays bit-identical on vs off.
     async_decode: bool = False
+    # Launches the async ring may hold in flight (1 = dispatch-then-
+    # resolve, 2 = the classic double buffer).
+    async_depth: int = 2
     # Speculative decoding: k >= 1 turns each decode iteration into
     # draft-and-verify — an n-gram prompt-lookup drafter (no second
     # model) proposes up to k tokens per slot from the slot's own
@@ -381,6 +384,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
             async_decode=args.async_decode,
+            async_depth=args.async_depth,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **_slo_kwargs(args),
@@ -442,6 +446,7 @@ def _make_fleet(args: ServeArgs, engine: ServeEngine):
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
             async_decode=args.async_decode,
+            async_depth=args.async_depth,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **_slo_kwargs(args),
@@ -489,6 +494,7 @@ def _resolve_megastep(args: ServeArgs, engine: ServeEngine,
         prefill_budget=args.prefill_budget,
         megastep="auto",
         async_decode=args.async_decode,
+        async_depth=args.async_depth,
         spec_k=args.spec_k or None,
         spec_ngram=args.spec_ngram,
         **_slo_kwargs(args),
@@ -548,6 +554,7 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
             prefill_budget=args.prefill_budget,
             megastep=args.megastep,
             async_decode=args.async_decode,
+            async_depth=args.async_depth,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
             **_slo_kwargs(args),
@@ -762,6 +769,14 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         out["async_decode"] = bool(args.async_decode)
         out["device_idle_fraction"] = round(
             stats.get("device_idle_fraction", 0.0), 4)
+        if args.async_decode:
+            out["async_depth"] = int(args.async_depth)
+            out["async_sync_fallbacks"] = int(
+                stats.get("async_sync_fallbacks", 0.0))
+            out["async_ring_depth_avg"] = round(
+                stats.get("async_ring_depth_avg", 0.0), 3)
+            out["async_fetch_wait_s"] = round(
+                stats.get("async_fetch_wait_s", 0.0), 4)
         out["spec_k"] = int(args.spec_k)
         if args.spec_k:
             out["spec_launches"] = int(stats.get("spec_launches", 0.0))

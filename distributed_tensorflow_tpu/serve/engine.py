@@ -55,6 +55,16 @@ PyTree = Any
 # pool and deadlock the rendezvous.  One program in flight at a time is
 # what the hardware does anyway; the lock just makes the queueing happen
 # host-side instead of inside XLA's rendezvous.
+#
+# THREAD DISCIPLINE for async serving: every compiled-program LAUNCH
+# (and every sharded device_put) takes this lock, whatever thread it
+# runs on.  A ``jax.device_get`` of a launch's OUTPUT is not a launch —
+# it joins the device stream read-only and needs no lock — which is
+# what lets the scheduler's dedicated fetch thread resolve in-flight
+# outputs while the loop thread dispatches the next program under the
+# lock.  Code on the fetch thread must never call anything that
+# compiles or launches (no ``jax.jit`` entry, no device_put of sharded
+# trees); it only ever touches launch outputs.
 _launch_lock = threading.Lock()
 
 
@@ -1264,12 +1274,45 @@ class ServeEngine:
             _gate, mutated["cache"], cache)
         return targets, accepted, gated, new_counts
 
+    def _verify_chain_apply(self, k, paged, params, cache, counts, tokens,
+                            active, draft_lens, block_tables, rng, counter,
+                            sampling, carry, fresh_tokens, fresh, clock):
+        """Speculative verify with a DEVICE-RESIDENT column 0 (async
+        decode): the host drafted from its stale fetched view, so the
+        scored context must NOT trust the host's idea of the last
+        token.  Column 0 is replaced on device by ``carry`` — the true
+        last token after every launch still in flight — merged with the
+        host's ``fresh_tokens`` for rows whose prefill finished while a
+        launch was in flight (the same fresh-row mask as the megastep).
+        The emitted targets are therefore exactly the sequential tokens
+        no matter how stale the drafting view was: staleness can only
+        shrink the accepted prefix, never corrupt a token.
+
+        The returned carry holds each ACTIVE row's last kept target
+        (``targets[i, accepted[i]]``); inactive rows keep their old
+        carry entry, so the carry stays a valid whole-batch input for
+        the next chained launch.  ``clock`` advances by one (a verify
+        launch is one scheduler iteration), keeping the device clock
+        chain pure device-side like the megastep's."""
+        col0 = jnp.where(fresh, fresh_tokens, carry)
+        tokens = jnp.concatenate([col0[:, None], tokens[:, 1:]], axis=1)
+        targets, accepted, gated, new_counts = self._verify_slots_apply(
+            k, paged, params, cache, counts, tokens, active, draft_lens,
+            block_tables, rng, counter, sampling)
+        idx = jnp.clip(accepted, 0, k)
+        last_kept = jnp.take_along_axis(targets, idx[:, None], axis=1)[:, 0]
+        carry_out = jnp.where(active, last_kept, col0)
+        clock_out = clock + 1
+        return targets, accepted, carry_out, clock_out, gated, new_counts
+
     def verify_slots(self, cache: PyTree, tokens: np.ndarray,
                      active: np.ndarray, draft_lens: np.ndarray, *,
                      temperature: float = 0.0, top_k: int = 0,
                      sampling=None, counts=None,
                      rng=None, counter: int = 0,
-                     paged=None, block_tables=None, params=None):
+                     paged=None, block_tables=None, params=None,
+                     chain: bool = False, carry=None,
+                     fresh_tokens=None, fresh=None, clock=None):
         """One speculative-decoding verify step over ALL slots.
 
         ``tokens`` is (num_slots, k+1) int32: column 0 is each slot's
@@ -1295,7 +1338,16 @@ class ServeEngine:
         penalties seeing targets 0..j-1 provisionally committed; only
         the accepted prefix + bonus token commits to the returned
         counts.  With ``counts`` the return grows to (targets, accepted,
-        cache, counts); without it the legacy 3-tuple holds."""
+        cache, counts); without it the legacy 3-tuple holds.
+
+        CHAIN MODE (``chain=True``, async decode): column 0 of
+        ``tokens`` is IGNORED and replaced on device by ``carry`` — the
+        device-resident last-token vector chained launch to launch —
+        merged with ``fresh_tokens`` at ``fresh`` rows (prefills that
+        landed while a launch was in flight), exactly the megastep's
+        async-dispatch contract.  ``clock`` chains the device iteration
+        counter.  The return grows to (targets, accepted, carry_out,
+        clock_out, cache, counts); requires per-request ``counts``."""
         if (paged is None) != (block_tables is None):
             raise ValueError("paged and block_tables go together")
         tokens = np.asarray(tokens, np.int32)
@@ -1306,13 +1358,20 @@ class ServeEngine:
                 f"decode step; route it there instead")
         k = tokens.shape[1] - 1
         legacy = counts is None
+        if chain and legacy:
+            raise ValueError(
+                "chain verify needs the per-request sampling state "
+                "(counts) — the async scheduler always carries it")
+        if chain and carry is None:
+            raise ValueError(
+                "chain verify needs the device token carry for column 0")
         if legacy:
             sampling, counts = self._uniform_sampling(
                 cache, temperature, top_k)
         elif sampling is None:
             sampling = sampling_lib.uniform(
                 self._slot_count_of(cache), temperature, top_k)
-        key = ("slot_verify", k, paged)
+        key = (("slot_verify_chain" if chain else "slot_verify"), k, paged)
         base = rng if rng is not None else self._sample_rng
         bt = block_tables
         if bt is not None and not isinstance(bt, jax.Array):
@@ -1320,17 +1379,44 @@ class ServeEngine:
         t0 = time.perf_counter()
         with _launch_lock:
             if key not in self._generate_fns:
-                self._note_compile("slot_verify")
+                self._note_compile(key[0])
+                fn = (self._verify_chain_apply if chain
+                      else self._verify_slots_apply)
                 self._generate_fns[key] = jax.jit(
-                    functools.partial(self._verify_slots_apply, k, paged),
+                    functools.partial(fn, k, paged),
                     donate_argnums=(1, 2))
             tokens_dev = jax.device_put(tokens, batch_sharding(self.mesh))
-            targets, accepted, gated, counts = self._generate_fns[key](
-                self.params if params is None else params, cache, counts,
-                tokens_dev, np.asarray(active, bool),
-                np.asarray(draft_lens, np.int32), bt, base, counter,
-                sampling)
+            if chain:
+                n = tokens.shape[0]
+                carry_dev = carry
+                if not isinstance(carry_dev, jax.Array):
+                    carry_dev = jax.device_put(
+                        np.asarray(carry_dev, np.int32).reshape(-1),
+                        batch_sharding(self.mesh))
+                if fresh_tokens is None:
+                    fresh_tokens = np.zeros((n,), np.int32)
+                elif not isinstance(fresh_tokens, jax.Array):
+                    fresh_tokens = np.asarray(
+                        fresh_tokens, np.int32).reshape(-1)
+                fresh = (np.zeros((n,), bool) if fresh is None
+                         else np.asarray(fresh, bool))
+                if clock is None:
+                    clock = np.int32(0)
+                (targets, accepted, carry_out, clock_out, gated,
+                 counts) = self._generate_fns[key](
+                    self.params if params is None else params, cache,
+                    counts, tokens_dev, np.asarray(active, bool),
+                    np.asarray(draft_lens, np.int32), bt, base, counter,
+                    sampling, carry_dev, fresh_tokens, fresh, clock)
+            else:
+                targets, accepted, gated, counts = self._generate_fns[key](
+                    self.params if params is None else params, cache, counts,
+                    tokens_dev, np.asarray(active, bool),
+                    np.asarray(draft_lens, np.int32), bt, base, counter,
+                    sampling)
         self._obs["verify"].observe(time.perf_counter() - t0)
+        if chain:
+            return targets, accepted, carry_out, clock_out, gated, counts
         if legacy:
             return targets, accepted, gated
         return targets, accepted, gated, counts

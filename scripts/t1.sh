@@ -14,8 +14,21 @@
 # The static-analysis gate (scripts/lint.sh — dttlint + ruff when
 # present) rides tier-1: a lint finding fails the gate even when every
 # test passes, but never masks a test failure's exit code.
+#
+# DTT_SERVE_ASYNC=1 adds an opt-in deep-async pass AFTER the gate: the
+# serve_slow async suites rerun with the launch ring at depth 4
+# (DTT_ASYNC_DEPTH=4 — three launches in flight behind every fetch),
+# so the parity/composition claims are re-proven beyond the default
+# double buffer.  Opt-in because the end-to-end decode compiles are
+# what tier-1's serve_slow exclusion exists to keep out of the gate.
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow and not serve_slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 bash scripts/lint.sh; lint_rc=$?
 [ "$rc" -eq 0 ] && rc=$lint_rc
+if [ "${DTT_SERVE_ASYNC:-0}" = "1" ]; then
+  timeout -k 10 1800 env JAX_PLATFORMS=cpu DTT_ASYNC_DEPTH=4 \
+    python -m pytest tests/test_serve_async.py -q -m serve_slow \
+    -p no:cacheprovider -p no:xdist -p no:randomly; async_rc=$?
+  [ "$rc" -eq 0 ] && rc=$async_rc
+fi
 exit $rc

@@ -155,11 +155,20 @@ def parse_args(argv=None):
                         "the timed run and pins the chosen K")
     p.add_argument("--async_decode", action="store_true",
                    default=defaults.async_decode,
-                   help="continuous mode: double-buffer the decode loop — "
-                        "dispatch megastep N+1 before fetching megastep "
-                        "N's tokens, overlapping host scheduling with "
-                        "device compute (one iteration of admission lag; "
-                        "greedy output is bit-identical on vs off)")
+                   help="continuous mode: run the decode loop ahead of the "
+                        "host view — dispatch each launch before resolving "
+                        "the previous ones (a ring --async_depth deep, "
+                        "fetched on a dedicated thread), overlapping host "
+                        "scheduling with device compute (up to depth-1 "
+                        "iterations of delivery lag; greedy output is "
+                        "bit-identical on vs off)")
+    p.add_argument("--async_depth", type=int,
+                   default=defaults.async_depth,
+                   help="continuous mode with --async_decode: launches the "
+                        "ring may hold in flight (1 = dispatch-then-"
+                        "resolve, 2 = the classic double buffer, higher "
+                        "rides out slower host iterations at more "
+                        "delivery lag)")
     p.add_argument("--spec_k", type=int, default=defaults.spec_k,
                    help="continuous mode: speculative decoding — an "
                         "n-gram prompt-lookup drafter (no second model) "

@@ -1,0 +1,81 @@
+"""Seeded deep-async launch-ring violations: the depth-D ring + fetch
+thread idiom done WRONG three ways — the dispatch half pins the
+pre-launch cache handle in a local across its own donation and reads it
+after the launch went out (``use-after-donate``), drains the ring by
+``float()``-ing the newest still-in-flight token on the scheduling
+thread instead of letting the fetch thread resolve the oldest record
+(``host-sync``, the stall that serializes the whole pipeline), and the
+fetch thread "recomputes" a lost fetch by re-launching a jitted program
+itself — a compiled-program launch from a worker thread with no
+module-level launch lock (``collective-launch``, the XLA-rendezvous
+deadlock).  Each rule must flag exactly its marked lines."""
+
+import collections
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_launch_lock = threading.Lock()
+
+
+class MiniRingEngine:
+    def __init__(self, module, params, cache, depth=4):
+        self.module = module
+        self.params = params
+        self._cache = cache
+        self.depth = depth
+        self._ring = collections.deque()
+        self._fetch_q = queue.Queue()
+        self._fetch_thread = threading.Thread(
+            target=self._fetch_worker, daemon=True)
+        self._step = jax.jit(self._decode_apply, donate_argnums=(1,))
+        self._redo = jax.jit(self._logits_apply)
+
+    def _decode_apply(self, params, cache, tok):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def _logits_apply(self, params, cache, tok):
+        out, _ = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out
+
+    def start(self):
+        self._fetch_thread.start()
+
+    def decode(self, tok, steps):
+        # Depth-D ring done WRONG: the pre-launch cache handle is
+        # pinned in a local, donated to the dispatch, then read — its
+        # buffer now belongs to the in-flight launch — and the drain
+        # host-syncs the NEWEST launch's token mid-loop instead of
+        # handing the oldest record to the fetch thread.
+        checksum = None
+        for _ in range(steps):
+            held = self._cache
+            with _launch_lock:
+                tok, self._cache = self._step(self.params, held, tok)
+            self._ring.append(tok)
+            if len(self._ring) >= self.depth:
+                self._ring.popleft()
+            checksum = jnp.sum(held)  # SEED: use-after-donate
+            if float(tok[0]) == 0:  # SEED: host-sync
+                break
+        return checksum
+
+    def _fetch_worker(self):
+        # "Recovers" a lost fetch by RE-LAUNCHING a jitted program from
+        # the fetch thread: a compiled launch off the loop thread with
+        # no module-level launch lock — the fetch thread's one job is
+        # ``jax.device_get``, never anything that compiles or launches.
+        while True:
+            rec = self._fetch_q.get()
+            if rec is None:
+                return
+            tok, fut = rec
+            p = self.params
+            c = self._cache
+            out = self._redo(p, c, tok)  # SEED: collective-launch
+            fut.set_result(jax.device_get(out))

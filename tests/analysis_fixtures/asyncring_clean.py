@@ -1,0 +1,73 @@
+"""Clean twin of ``asyncring_bad``: the same depth-D launch ring +
+fetch thread shape, done to the shipped discipline — the donated cache
+is rebound in the SAME assignment as every launch (the chaining idiom),
+ring records carry only launch OUTPUTS behind a ``Future`` the fetch
+thread resolves through the single sanctioned ``jax.device_get``, the
+scheduling thread drains oldest-first and only ever touches resolved
+host values, and the fetch thread launches nothing.  Zero findings
+expected."""
+
+import collections
+import queue
+import threading
+from concurrent.futures import Future
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class MiniRingEngine:
+    def __init__(self, module, params, cache, depth=4):
+        self.module = module
+        self.params = params
+        self._cache = cache
+        self.depth = depth
+        self._ring = collections.deque()
+        self._fetch_q = queue.Queue()
+        self._fetch_thread = threading.Thread(
+            target=self._fetch_worker, daemon=True)
+        self._step = jax.jit(self._decode_apply, donate_argnums=(1,))
+
+    def _decode_apply(self, params, cache, tok):
+        out, mutated = self.module.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"])
+        return out, mutated["cache"]
+
+    def start(self):
+        self._fetch_thread.start()
+
+    def decode(self, tok, steps):
+        # Depth-D ring: dispatch up to depth-1 launches ahead, enqueue
+        # each output for the fetch thread, resolve strictly oldest-
+        # first through the record's Future — the scheduling thread
+        # never host-syncs an in-flight device value.
+        out = None
+        for _ in range(steps):
+            with _launch_lock:
+                tok, self._cache = self._step(
+                    self.params, self._cache, tok)
+            fut = Future()
+            self._fetch_q.put((tok, fut))
+            self._ring.append(fut)
+            while len(self._ring) >= self.depth:
+                out = self._ring.popleft().result()
+                if int(out[0]) == 0:
+                    return out
+        while self._ring:
+            out = self._ring.popleft().result()
+        return out
+
+    def close(self):
+        self._fetch_q.put(None)
+        self._fetch_thread.join()
+
+    def _fetch_worker(self):
+        # The fetch half: one ``jax.device_get`` per record, nothing
+        # that compiles or launches.
+        while True:
+            rec = self._fetch_q.get()
+            if rec is None:
+                return
+            tok, fut = rec
+            fut.set_result(jax.device_get(tok))

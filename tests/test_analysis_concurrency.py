@@ -68,6 +68,7 @@ class TestSeededFixtures:
         ("launch", CollectiveLaunchRule, "collective-launch"),
         ("megastep", CollectiveLaunchRule, "collective-launch"),
         ("spec", CollectiveLaunchRule, "collective-launch"),
+        ("asyncring", CollectiveLaunchRule, "collective-launch"),
     ]
 
     @pytest.mark.parametrize("stem,rule_cls,rule_id",

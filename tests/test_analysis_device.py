@@ -50,6 +50,8 @@ CASES = [
     ("hostsync", HostSyncRule, "host-sync"),
     ("async", UseAfterDonateRule, "use-after-donate"),
     ("async", HostSyncRule, "host-sync"),
+    ("asyncring", UseAfterDonateRule, "use-after-donate"),
+    ("asyncring", HostSyncRule, "host-sync"),
     ("gateway", HostSyncRule, "host-sync"),
     ("tiering", HostSyncRule, "host-sync"),
 ]

@@ -1,11 +1,13 @@
-"""Async double-buffered decode tests: dispatching megastep N+1 before
-fetching megastep N's tokens must be a pure SCHEDULING change — greedy
-output is bit-identical async on vs off, dense and paged, on both
-acceptance meshes, composed with megastep, chunked prefill, the prefix
-cache, speculative decoding and mid-stream hot reload — while the one
-semantic it does change is pinned explicitly: a request submitted while
-megastep N is in flight decodes no token before iteration N+2 (one
-iteration of admission lag buys the overlap).
+"""Deep async decode tests: dispatching up to ``async_depth`` megasteps
+ahead of the oldest unfetched launch must be a pure SCHEDULING change —
+greedy output is bit-identical async on vs off at every depth, dense and
+paged, on both acceptance meshes, composed with megastep, chunked
+prefill, the prefix cache, speculative decoding and mid-stream hot
+reload — while the semantics it does change are pinned explicitly: a
+request submitted while a launch ring is in flight sees its first
+decoded tokens only after the ring wraps (admission lag buys the
+overlap), and launches resolve strictly in dispatch order off the
+dedicated fetch thread.
 
 ``--megastep=auto`` rides the same loop: the autotuner picks K from the
 observed dispatch-vs-step-time ratio and FREEZES, so compiled-program
@@ -14,12 +16,24 @@ timing source (no real clocks in the assert path).
 
 The ctor-validation and stubbed-autotune tests never launch a decode
 program and run in tier-1; everything that compiles end-to-end decode
-carries ``serve_slow`` (excluded from tier-1 alongside ``slow``)."""
+carries ``serve_slow`` (excluded from tier-1 alongside ``slow``).
+
+``DTT_ASYNC_DEPTH`` overrides the ring depth the async schedulers here
+run at (default 2 — the classic double buffer); ``scripts/t1.sh``'s
+opt-in ``DTT_SERVE_ASYNC=1`` pass reruns the serve_slow suites at
+depth 4."""
+
+import os
 
 import numpy as np
 import pytest
 
 from distributed_tensorflow_tpu.serve import ContinuousScheduler, ServeEngine
+
+# Ring depth for the async schedulers under test.  2 is today's double
+# buffer; the t1.sh DTT_SERVE_ASYNC pass exports 4 so every parity and
+# composition claim is re-proven with three launches in flight.
+_DEPTH = int(os.environ.get("DTT_ASYNC_DEPTH", "2"))
 
 
 def _mixed_requests(vocab, seed=3):
@@ -62,10 +76,16 @@ class TestCtorValidation:
             ContinuousScheduler(gpt2_engine, megastep="auto", spec_k=2,
                                 start=False)
 
+    def test_bad_async_depth_rejected(self, gpt2_engine):
+        with pytest.raises(ValueError, match="async_depth"):
+            ContinuousScheduler(gpt2_engine, async_decode=True,
+                                async_depth=0, start=False)
+
     def test_stats_export_async_keys(self, gpt2_engine):
         sched = ContinuousScheduler(gpt2_engine, num_slots=8,
                                     max_total_len=32, megastep="auto",
-                                    async_decode=True, start=False)
+                                    async_decode=True, async_depth=4,
+                                    start=False)
         stats = sched.stats()
         assert stats["async_decode"] == 1.0
         assert stats["megastep_auto"] == 1.0
@@ -73,6 +93,13 @@ class TestCtorValidation:
         assert stats["megastep"] == 1.0  # autotune starts at the classic K
         assert stats["device_clock"] == 0.0
         assert stats["device_idle_fraction"] == 0.0
+        assert stats["async_depth"] == 4.0
+        assert stats["async_sync_fallbacks"] == 0.0
+        assert stats["async_ring_depth_avg"] == 0.0
+        assert stats["async_ring_depth_max"] == 0.0
+        assert stats["async_fetch_wait_s"] == 0.0
+        # The fetch thread is lazy: nothing dispatched, nothing started.
+        assert sched._fetch_thread is None
         sched.close(timeout=0.1)
 
 
@@ -98,11 +125,13 @@ class TestAsyncParity:
         with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
             baseline = _run_all(sched, reqs)
         with ContinuousScheduler(gpt2_engine, megastep=steps,
-                                 async_decode=True, **kwargs) as sched:
+                                 async_decode=True, async_depth=_DEPTH,
+                                 **kwargs) as sched:
             overlapped = _run_all(sched, reqs)
             stats = sched.stats()
             assert stats["async_decode"] == 1.0
             assert stats["megastep_launches"] > 0
+            assert stats["async_sync_fallbacks"] == 0.0
         for (prompt, horizon), base, out in zip(reqs, baseline,
                                                 overlapped):
             np.testing.assert_array_equal(out, base)
@@ -121,7 +150,7 @@ class TestAsyncParity:
             with ContinuousScheduler(eng, **kwargs) as sched:
                 baseline = _run_all(sched, reqs)
             with ContinuousScheduler(eng, megastep=4, async_decode=True,
-                                     **kwargs) as sched:
+                                     async_depth=_DEPTH, **kwargs) as sched:
                 overlapped = _run_all(sched, reqs)
             for base, out in zip(baseline, overlapped):
                 np.testing.assert_array_equal(out, base)
@@ -139,9 +168,14 @@ class TestAsyncComposition:
         with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
             baseline = _run_all(sched, reqs)
         with ContinuousScheduler(gpt2_engine, prefill_budget=4, megastep=4,
-                                 async_decode=True, **kwargs) as sched:
+                                 async_decode=True, async_depth=_DEPTH,
+                                 **kwargs) as sched:
             stacked = _run_all(sched, reqs)
-            assert sched.stats()["prefill_chunks"] > len(reqs)
+            stats = sched.stats()
+            assert stats["prefill_chunks"] > len(reqs)
+            # Final chunks ride the ring now: chunked prefill no longer
+            # flushes the pipeline every iteration.
+            assert stats["async_sync_fallbacks"] == 0.0
         for base, out in zip(baseline, stacked):
             np.testing.assert_array_equal(out, base)
 
@@ -158,6 +192,7 @@ class TestAsyncComposition:
         for async_on in (False, True):
             with ContinuousScheduler(gpt2_engine, megastep=8,
                                      async_decode=async_on,
+                                     async_depth=_DEPTH,
                                      **kwargs) as sched:
                 outs = [sched.submit(p, max_new_tokens=m).result(timeout=300)
                         for p, m in reqs]
@@ -171,17 +206,33 @@ class TestAsyncComposition:
             np.testing.assert_array_equal(out, base)
 
     def test_spec_decoding_composes(self, gpt2_engine):
-        """Per-request draft lengths need the sync spec path; an
-        async_decode scheduler must fall back to it transparently and
-        stay bit-identical."""
+        """Speculative drafts build from the N-1 fetched view and verify
+        against the device-resident carry, so spec_k rides the ring
+        instead of flushing it: zero sync fallbacks, real verify
+        launches, and greedy output bit-identical to the classic
+        scheduler (the drafter is correctness-neutral — a stale draft
+        only costs acceptance, never tokens).  Horizons are long and
+        prompts self-repeating: the ring budgets worst-case in-flight
+        tokens against the horizon, so at depth 4 a short request never
+        has draft room — drafts need ``max_new_tokens`` comfortably
+        past ``(depth - 1) * (spec_k + 1)``, and the doubled prompt
+        guarantees the n-gram drafter a hit."""
         vocab = gpt2_engine.module.cfg.vocab_size
-        reqs = _mixed_requests(vocab, seed=11)
-        kwargs = dict(num_slots=8, max_total_len=32)
+        rng = np.random.default_rng(11)
+        reqs = []
+        for length, horizon in ((4, 12), (6, 16), (9, 14),
+                                (8, 15), (5, 13), (6, 16)):
+            base = rng.integers(0, vocab, size=(length,), dtype=np.int32)
+            reqs.append((np.concatenate([base, base]), horizon))
+        kwargs = dict(num_slots=8, max_total_len=64)
         with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
             baseline = _run_all(sched, reqs)
         with ContinuousScheduler(gpt2_engine, spec_k=2, async_decode=True,
-                                 **kwargs) as sched:
+                                 async_depth=_DEPTH, **kwargs) as sched:
             specced = _run_all(sched, reqs)
+            stats = sched.stats()
+            assert stats["async_sync_fallbacks"] == 0.0
+            assert stats["spec_launches"] > 0
         for base, out in zip(baseline, specced):
             np.testing.assert_array_equal(out, base)
 
@@ -216,6 +267,155 @@ class TestAsyncComposition:
             assert sched.generation == gen0 + 7
         np.testing.assert_array_equal(
             out, _fixed_reference(gpt2_engine, whale, 6))
+
+
+@pytest.mark.serve_slow
+class TestLaunchRing:
+    """Depth > 2: the ring holds several launches in flight and the
+    dedicated fetch thread resolves them strictly in dispatch order."""
+
+    def test_depth4_parity_and_ring_occupancy(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, seed=23)
+        kwargs = dict(num_slots=8, max_total_len=32, cache_mode="paged",
+                      block_size=4)
+        with ContinuousScheduler(gpt2_engine, **kwargs) as sched:
+            baseline = _run_all(sched, reqs)
+        with ContinuousScheduler(gpt2_engine, megastep=2, async_decode=True,
+                                 async_depth=4, **kwargs) as sched:
+            deep = _run_all(sched, reqs)
+            stats = sched.stats()
+            assert stats["async_depth"] == 4.0
+            assert stats["async_sync_fallbacks"] == 0.0
+            # The free-running loop must actually have used the extra
+            # head-room at least once, and never exceeded it.
+            assert 2.0 <= stats["async_ring_depth_max"] <= 4.0
+        for base, out in zip(baseline, deep):
+            np.testing.assert_array_equal(out, base)
+
+    def test_depth4_defers_resolution_in_launch_order(self, gpt2_engine):
+        """Manual stepping at depth 4: the deferred prefill record
+        resolves via the progress rule (nothing else is dispatchable),
+        then decode dispatches D1..D3 stack up with no fetch; the 4th
+        decode dispatch resolves exactly D1 (launch order), so the
+        request's token count jumps by ONE megastep, not three."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, vocab, size=(4,), dtype=np.int32)
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, megastep=2,
+                                    async_decode=True, async_depth=4,
+                                    start=False)
+        try:
+            fut = sched.submit(prompt, max_new_tokens=12)
+
+            def ntok():
+                with sched._lock:
+                    return len(next(iter(sched._active.values())).tokens)
+
+            sched._iteration()          # it1: prefill -> progress-resolve
+            assert ntok() == 1 and len(sched._ring) == 0
+            sched._iteration()          # it2: dispatch D1 — no fetch yet
+            assert ntok() == 1 and len(sched._ring) == 1
+            sched._iteration()          # it3: dispatch D2 — no fetch yet
+            sched._iteration()          # it4: dispatch D3 — no fetch yet
+            assert ntok() == 1 and len(sched._ring) == 3
+            sched._iteration()          # it5: dispatch D4 -> resolve D1
+            assert ntok() == 3          # prefill + D1's two tokens only
+            assert len(sched._ring) == 3
+            n = 0
+            while not fut.done() and n < 40:
+                sched._iteration()
+                n += 1
+            out = np.asarray(fut.result(timeout=60))
+        finally:
+            sched.close(timeout=5.0)
+        assert not sched._ring          # close() drained the ring
+        np.testing.assert_array_equal(
+            out, _fixed_reference(gpt2_engine, prompt, 12))
+
+    def test_on_token_streams_post_trim_in_order(self, gpt2_engine):
+        """``on_token`` fires per resolved megastep AFTER horizon trim
+        with the list of newly decoded tokens: concatenated, the
+        streamed sequence is exactly the final result, in order — an
+        out-of-order fetch or an untrimmed ragged tail would both show
+        up here."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, seed=31)
+        streamed = [[] for _ in reqs]
+        with ContinuousScheduler(gpt2_engine, num_slots=8, max_total_len=32,
+                                 megastep=4, async_decode=True,
+                                 async_depth=4) as sched:
+            futs = [sched.submit(p, max_new_tokens=m,
+                                 on_token=streamed[i].extend)
+                    for i, (p, m) in enumerate(reqs)]
+            outs = [f.result(timeout=300) for f in futs]
+        for (prompt, horizon), got, out in zip(reqs, streamed, outs):
+            assert len(got) == horizon == len(out)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
+
+    def test_fetch_thread_clean_shutdown(self, gpt2_engine):
+        vocab = gpt2_engine.module.cfg.vocab_size
+        reqs = _mixed_requests(vocab, seed=37)
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, megastep=2,
+                                    async_decode=True, async_depth=4)
+        try:
+            _run_all(sched, reqs)
+            fetcher = sched._fetch_thread
+            assert fetcher is not None and fetcher.is_alive()
+        finally:
+            sched.close(timeout=10.0)
+        assert not fetcher.is_alive()
+        assert sched._fetch_q.empty()
+        assert not sched._ring
+        sched.close(timeout=1.0)  # idempotent
+
+    def test_cancel_mid_ring_frees_blocks_once(self, gpt2_engine):
+        """Regression: ``cancel(rid)`` with >= 2 launches in flight must
+        retire at the fetch boundary — the whole ring drains first (so
+        freed blocks can't take a zombie device write), the KV blocks
+        release exactly once, and the survivor's output is untouched."""
+        vocab = gpt2_engine.module.cfg.vocab_size
+        rng = np.random.default_rng(41)
+        prompt_a = rng.integers(0, vocab, size=(4,), dtype=np.int32)
+        prompt_b = rng.integers(0, vocab, size=(4,), dtype=np.int32)
+        sched = ContinuousScheduler(gpt2_engine, num_slots=8,
+                                    max_total_len=32, cache_mode="paged",
+                                    block_size=4, megastep=2,
+                                    async_decode=True, async_depth=4,
+                                    start=False)
+        try:
+            fut_a = sched.submit(prompt_a, max_new_tokens=12)
+            fut_b = sched.submit(prompt_b, max_new_tokens=12)
+            sched._iteration()          # prefill both + dispatch D1
+            sched._iteration()          # dispatch D2
+            sched._iteration()          # dispatch D3
+            assert len(sched._ring) >= 2
+            assert sched.cancel(fut_b.rid) is True
+            n = 0
+            while not (fut_a.done() and fut_b.done()) and n < 40:
+                sched._iteration()
+                n += 1
+            with pytest.raises(Exception) as ei:
+                fut_b.result(timeout=60)
+            assert "cancel" in type(ei.value).__name__.lower()
+            out_a = np.asarray(fut_a.result(timeout=60))
+            stats = sched.stats()
+            # Every block back in the pool exactly once: a double free
+            # would under-run blocks_in_use or poison the free list for
+            # the next admission.
+            assert stats["blocks_in_use"] == 0.0
+            fut_c = sched.submit(prompt_b, max_new_tokens=4)
+            n = 0
+            while not fut_c.done() and n < 40:
+                sched._iteration()
+                n += 1
+            fut_c.result(timeout=60)
+        finally:
+            sched.close(timeout=5.0)
+        np.testing.assert_array_equal(
+            out_a, _fixed_reference(gpt2_engine, prompt_a, 12))
 
 
 @pytest.mark.serve_slow

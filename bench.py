@@ -109,7 +109,7 @@ def _spread(rates):
 
 _SERVE_ARM_GROUPS = ("chunked", "megastep", "spec", "paged", "fleet",
                      "prefix", "sampling", "async", "async_depth",
-                     "streaming", "slo")
+                     "streaming", "slo", "loadgen")
 
 
 def _parse_serve_arms(spec):
@@ -442,6 +442,183 @@ def _slo_arm(engine, cont, block_size):
         "slo_blocks_in_use_after": int(on["blocks_in_use"]),
         "slo_compile_post_warmup": compile_post_warmup,
     }
+
+
+def _loadgen_arm(engine, cont, block_size):
+    """Goodput observatory A/B: ONE deterministic open-loop trace
+    (seeded Poisson arrivals, whales + chat turns + shared prefixes +
+    mixed tiers) replayed against the SAME undersized paged pool with
+    ``slo_scheduling`` off, then on — both with a lifecycle recorder
+    attached — plus a recorder-off replay for the overhead bound.
+
+    Hard asserts (contracts, not timing claims): recorder-on greedy
+    outputs are BIT-IDENTICAL to recorder-off (same trace digest) and
+    best-of-N throughput stays within 3%; every retired request's
+    breakdown components sum to its measured wall time within 5%;
+    goodput-under-SLO with ranked admission is no worse than FIFO on the
+    pressure trace; and NOTHING compiled after the warm phase with the
+    recorder enabled (recording must never perturb program identity)."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.obs.lifecycle import (
+        PHASES,
+        LifecycleRecorder,
+    )
+    from distributed_tensorflow_tpu.serve.continuous import (
+        ContinuousScheduler,
+    )
+    from distributed_tensorflow_tpu.serve.loadgen import build_trace, run_trace
+
+    vocab = engine.module.cfg.vocab_size
+    whale_len, whale_new = 8, 24
+    short_len, short_new = 4, 6
+    max_total = whale_len + whale_new
+    blocks_whale = -(-(max_total - 1) // block_size)
+    blocks_short = -(-(short_len + short_new - 1) // block_size)
+    # Undersized pool (the _slo_arm recipe): a resident whale starves
+    # shorts unless ranked admission preempts it — the pressure the
+    # goodput ordering needs to be a real experiment.
+    pool = blocks_whale + 2 * blocks_short
+    trace_kwargs = dict(
+        seed=cont.seed + 23, process="poisson", rate=200.0, vocab=vocab,
+        short_len=short_len, short_new=short_new,
+        whale_len=whale_len, whale_new=whale_new,
+        whale_frac=0.25, chat_frac=0.25, chat_turns=2,
+        chat_turn_growth=2, shared_frac=0.15, shared_group=3,
+        max_total_len=max_total)
+    trace = build_trace(20, **trace_kwargs)
+    mk = dict(num_slots=4, max_total_len=max_total, cache_mode="paged",
+              block_size=block_size, num_blocks=pool, max_queue_size=64)
+
+    def replay(trace_, *, slo, recorder, speed=1e4, megastep=None):
+        rec = LifecycleRecorder() if recorder else None
+        kw = dict(mk)
+        if slo:
+            kw.update(slo_scheduling=True, swap_min_tokens=4)
+        if megastep is not None:
+            kw.update(megastep=megastep)
+        sched = ContinuousScheduler(engine, lifecycle=rec, **kw)
+        try:
+            report = run_trace(sched, trace_, speed=speed,
+                               lifecycle=rec)
+        finally:
+            sched.close()
+            if rec is not None:
+                rec.close()
+        return report, rec
+
+    # Warm phase: the full trace through BOTH configs with the recorder
+    # ON compiles every prefill/decode/tiering shape the timed phases
+    # can reach before the compile counter is snapshotted.
+    replay(trace, slo=False, recorder=True)
+    replay(trace, slo=True, recorder=True)
+    compile_warm = engine.compile_stats()["compile_total"]
+
+    # Timed A/B on the pressure trace, recorder on both sides.
+    off_report, _ = replay(trace, slo=False, recorder=True)
+    on_report, on_rec = replay(trace, slo=True, recorder=True)
+
+    # Breakdown invariant: per retired request, the six phases partition
+    # submit->retire wall time.  5% tolerance plus a 2ms jitter floor
+    # (sub-millisecond walls amplify scheduler-tick noise into huge
+    # ratios).
+    breakdowns = on_rec.breakdowns()
+    assert breakdowns, "lifecycle recorder saw no completed requests"
+    for b in breakdowns:
+        parts = sum(b[p] for p in PHASES)
+        tol = max(0.05 * b["wall"], 0.002)
+        assert abs(parts - b["wall"]) <= tol, (
+            f"breakdown does not sum to wall for rid {b['rid']}: "
+            f"parts={parts:.4f}s wall={b['wall']:.4f}s "
+            f"(tol {tol:.4f}s): {b}")
+
+    compile_post_warmup = int(
+        engine.compile_stats()["compile_total"] - compile_warm)
+    assert compile_post_warmup == 0, (
+        f"loadgen arm compiled {compile_post_warmup} programs after "
+        f"warm with the recorder on — recording must never perturb "
+        f"program identity")
+
+    goodput_on = on_report["goodput_under_slo"]
+    goodput_off = off_report["goodput_under_slo"]
+    assert goodput_on >= goodput_off, (
+        f"SLO scheduling worsened goodput-under-SLO on the open-loop "
+        f"pressure trace: on={goodput_on:.3f} off={goodput_off:.3f}")
+
+    # Recorder overhead bound: a dedicated decode-heavy trace (the
+    # pressure trace is too short to resolve 3% against CPU scheduler
+    # jitter), replayed at megastep=4 — the realistic throughput
+    # config, where tokens land four-per-fetch and the recorder folds
+    # one batch per fetch instead of one call per token — with off/on
+    # INTERLEAVED so load drift on a shared box lands on both sides
+    # equally.  Best-of converges to the noise floor, so the residual
+    # gap IS the recorder's cost.  Outputs must stay bit-identical and
+    # throughput within 3%.
+    tput_trace = build_trace(
+        32, seed=cont.seed + 37, process="poisson", rate=500.0,
+        vocab=vocab, short_len=short_len, short_new=24,
+        whale_frac=0.0, chat_frac=0.0, shared_frac=0.0,
+        max_total_len=max_total)
+    # Warm the megastep-4 shapes (recorder on) before the timed loop;
+    # the compile_post_warmup==0 assert above already snapshotted the
+    # K=1 arms, so these compiles are accounted separately.
+    replay(tput_trace, slo=False, recorder=True, megastep=4)
+
+    # Best-of converges UPWARD (noise only slows a replay down, never
+    # speeds it up), so keep adding interleaved pairs until the
+    # running-best gap clears the bound — a shared box under
+    # noisy-neighbour steal can swing single replays tens of percent,
+    # which fixed-N sampling cannot ride out.
+    tps = {False: 0.0, True: 0.0}
+    digest = {False: None, True: None}
+    rounds = 0
+    overhead = 1.0
+    for rounds in range(1, 13):
+        for recorder in (False, True):
+            rep, _rec = replay(tput_trace, slo=False, recorder=recorder,
+                               megastep=4)
+            if digest[recorder] is None:
+                digest[recorder] = rep["tokens_checksum"]
+            else:
+                assert rep["tokens_checksum"] == digest[recorder], (
+                    "greedy outputs drifted between replays of the "
+                    "same trace")
+            tps[recorder] = max(tps[recorder], rep["tokens_per_sec"])
+        overhead = (1.0 - tps[True] / tps[False]
+                    if tps[False] > 0 else 0.0)
+        if rounds >= 3 and overhead <= 0.03:
+            break
+    tps_off, tps_on = tps[False], tps[True]
+    assert digest[True] == digest[False], (
+        f"lifecycle recorder changed greedy outputs: "
+        f"on={digest[True]} off={digest[False]}")
+    assert overhead <= 0.03, (
+        f"lifecycle recorder costs {overhead:.1%} tokens/sec "
+        f"(best-of-{rounds} on={tps_on:.1f} off={tps_off:.1f}) — the "
+        f"host-side tap must stay under 3%")
+
+    lc = on_report["lifecycle"]
+    out = {
+        "goodput_under_slo": round(goodput_on, 4),
+        "goodput_loadgen_off": round(goodput_off, 4),
+        "shed_rate": round(on_report["shed_rate"], 4),
+        "loadgen_requests": on_report["requests_total"],
+        "loadgen_recorder_overhead": round(max(overhead, 0.0), 4),
+        "loadgen_recorder_parity": True,  # hard-asserted above
+        "loadgen_compile_post_warmup": compile_post_warmup,
+        "breakdown_sum_to_wall_ratio": round(
+            lc["breakdown_sum_to_wall_ratio"], 4),
+    }
+    for phase in ("queue_wait", "prefill", "swap"):
+        out[f"ttft_breakdown_{phase}_p99_ms"] = round(
+            lc[f"ttft_breakdown_{phase}_p99_ms"], 3)
+    for phase in PHASES:
+        out[f"breakdown_{phase}_p99_ms"] = round(
+            lc[f"breakdown_{phase}_p99_ms"], 3)
+    # Recorder detached from the shared bench engine so later arms (in
+    # single-process multi-arm runs) record nothing.
+    engine.set_lifecycle(None)
+    return out
 
 
 def _serve_bench(flags):
@@ -1152,6 +1329,8 @@ def _serve_bench(flags):
             out.update(_streaming_arm(engine, continuous, block_size))
         if "slo" in arms:
             out.update(_slo_arm(engine, continuous, block_size))
+        if "loadgen" in arms:
+            out.update(_loadgen_arm(engine, continuous, block_size))
     finally:
         engine.close()
         if chunk_engine is not engine:

@@ -28,6 +28,10 @@ from distributed_tensorflow_tpu.obs.metrics import (
     default_registry,
 )
 from distributed_tensorflow_tpu.obs.trace import Tracer, default_tracer
+from distributed_tensorflow_tpu.obs.lifecycle import (
+    EMPTY_LIFECYCLE_STATS,
+    LifecycleRecorder,
+)
 from distributed_tensorflow_tpu.obs.exporters import (
     JsonlMetricsWriter,
     MetricsServer,
@@ -47,9 +51,11 @@ from distributed_tensorflow_tpu.obs.serve import ServeMonitorHook
 
 __all__ = [
     "Counter",
+    "EMPTY_LIFECYCLE_STATS",
     "Gauge",
     "Histogram",
     "JsonlMetricsWriter",
+    "LifecycleRecorder",
     "MetricsFileWriter",
     "MetricsServer",
     "PrefetchMonitorHook",

@@ -168,6 +168,29 @@ class ServeMonitorHook(Hook):
                     int(s.get("spec_emitted", 0)),
                     s.get("spec_tokens_per_launch", 0.0),
                 )
+            if s.get("lifecycle_enabled", 0):
+                # Lifecycle attribution: where p99 wall time actually
+                # went.  sum/wall drifting below ~1.0 means a phase is
+                # leaking out of the partition (file a bug); queue_wait
+                # dominating means admission, not compute, is the
+                # bottleneck.
+                logger.info(
+                    "serve @ %d: lifecycle reqs=%d events=%d dropped=%d "
+                    "wall_p99=%.1fms queue=%.1f prefill=%.1f "
+                    "decode=%.1f fetch=%.1f swap=%.1f stall=%.1f "
+                    "sum/wall=%.3f",
+                    step, int(s.get("lifecycle_requests_total", 0)),
+                    int(s.get("lifecycle_events_total", 0)),
+                    int(s.get("lifecycle_dropped_total", 0)),
+                    s.get("breakdown_wall_p99_ms", 0.0),
+                    s.get("breakdown_queue_wait_p99_ms", 0.0),
+                    s.get("breakdown_prefill_p99_ms", 0.0),
+                    s.get("breakdown_decode_compute_p99_ms", 0.0),
+                    s.get("breakdown_fetch_wait_p99_ms", 0.0),
+                    s.get("breakdown_swap_p99_ms", 0.0),
+                    s.get("breakdown_scheduler_stall_p99_ms", 0.0),
+                    s.get("breakdown_sum_to_wall_ratio", 0.0),
+                )
         else:
             logger.info(
                 "serve @ %d: depth=%d/%d done=%d rej=%d batches=%d "

@@ -43,6 +43,45 @@ class Tracer:
         self._lock = threading.Lock()
         self._epoch = time.monotonic()
         self._enabled = enabled
+        self._dropped = 0
+        self._drop_metric = None
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        """Ring append that counts evictions — a truncated flight
+        recording must never be mistaken for a complete one."""
+        metric = None
+        with self._lock:
+            if (self._events.maxlen is not None
+                    and len(self._events) == self._events.maxlen):
+                self._dropped += 1
+                if self._drop_metric is None:
+                    # Lazy so this module stays dependency-free at
+                    # import time (obs/__init__ requires metrics/trace
+                    # to import nothing from the package).
+                    from distributed_tensorflow_tpu.obs.metrics import (
+                        default_registry)
+
+                    self._drop_metric = default_registry().counter(
+                        "dtt_trace_dropped_total",
+                        "trace ring-buffer events evicted before export")
+                metric = self._drop_metric
+            self._events.append(ev)
+        if metric is not None:
+            metric.inc()
+
+    @property
+    def dropped_events(self) -> int:
+        """Events evicted from the ring since construction/clear()."""
+        with self._lock:
+            return self._dropped
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "trace_enabled": float(self._enabled),
+                "trace_events": float(len(self._events)),
+                "trace_dropped_events": float(self._dropped),
+            }
 
     @property
     def enabled(self) -> bool:
@@ -65,6 +104,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._dropped = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -97,8 +137,7 @@ class Tracer:
         }
         if args:
             ev["args"] = dict(args)
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
 
     def add_instant(
         self,
@@ -121,8 +160,38 @@ class Tracer:
         }
         if args:
             ev["args"] = dict(args)
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
+
+    def add_flow(
+        self,
+        name: str,
+        *,
+        id: int,
+        phase: str,
+        cat: str = "",
+        tid: int = 0,
+        t: Optional[float] = None,
+    ) -> None:
+        """Record a flow event (``phase``: "s" start, "t" step, "f"
+        finish).  Flows with the same ``id`` draw connecting arrows in
+        Perfetto — the serve path uses the request id to link the
+        gateway span to the scheduler's per-rid lane."""
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "cat": cat or "flow",
+            "ph": phase,
+            "id": int(id),
+            "ts": self._us(time.monotonic() if t is None else t),
+            "pid": 0,
+            "tid": int(tid),
+        }
+        if phase == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice's end
+        self._append(ev)
 
     @contextmanager
     def span(

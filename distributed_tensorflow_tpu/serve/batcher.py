@@ -173,6 +173,14 @@ class DynamicBatcher:
 
     # -- client surface ------------------------------------------------------
 
+    @property
+    def scheduler(self):
+        """The continuous scheduler behind iteration-level mode (None on
+        the fixed-batch path) — the open-loop load harness
+        (``serve.loadgen.run_trace``) drives its richer ``submit``
+        surface (``sampling=``, ``on_token=``) directly."""
+        return self._scheduler
+
     def submit(self, payload: Any) -> Future:
         """Enqueue one request; returns a Future resolving to its result.
 

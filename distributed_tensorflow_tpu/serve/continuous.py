@@ -186,6 +186,7 @@ import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+from distributed_tensorflow_tpu.obs.lifecycle import EMPTY_LIFECYCLE_STATS
 from distributed_tensorflow_tpu.obs.trace import default_tracer
 from distributed_tensorflow_tpu.serve.batcher import (
     ServeOverloadedError,
@@ -571,6 +572,7 @@ class ContinuousScheduler:
         slo_scheduling: bool = False,
         swap_min_tokens: int = 32,
         starvation_age_s: float = 5.0,
+        lifecycle=None,
         name: str = "serve-continuous",
         start: bool = True,
     ):
@@ -746,11 +748,22 @@ class ContinuousScheduler:
         # Host-RAM KV tier: parks victims' private block bytes.  Paged
         # mode only — dense slo scheduling still ranks admission but has
         # no per-block residency to reclaim, so it never preempts.
+        # Lifecycle recorder (obs.lifecycle.LifecycleRecorder or None):
+        # a host-side tap the hook sites below feed typed events — only
+        # values the loop already holds (timestamps, counts, byte
+        # sizes), never a device array.  None (default) keeps every
+        # path bit-identical to the unrecorded scheduler.
+        self._lifecycle = lifecycle
+        if lifecycle is not None:
+            # Compile taps (rid 0) let the bench cross-check its
+            # compile_post_warmup == 0 assert against lifecycle events.
+            engine.set_lifecycle(lifecycle)
         self._tier_pool: Optional[HostKVPool] = None
         if self.slo_scheduling and cache_mode == "paged":
             self._tier_pool = HostKVPool(
                 engine, paged=self.paged,
-                policy=SwapPolicy(swap_min_tokens=self.swap_min_tokens))
+                policy=SwapPolicy(swap_min_tokens=self.swap_min_tokens),
+                lifecycle=lifecycle)
         # paged: reserved-but-unallocated blocks, per shard
         self._reserved = [0] * shards
         self._blocks_per_request: collections.deque = collections.deque(
@@ -999,6 +1012,18 @@ class ContinuousScheduler:
             self._obs["submitted"].inc()
             self._obs["depth"].set(len(self._queue))
             self._cond.notify()
+            depth = len(self._queue)
+        if self._lifecycle is not None:
+            # Host-side tap, outside the scheduler lock: the submit
+            # stamp the request already carries, plus the depth it
+            # queued behind.  QUEUED is export-only colour (the fold
+            # keys queue_wait off SUBMIT -> ADMITTED alone).
+            self._lifecycle.record(
+                req.rid, "SUBMIT", t=req.submitted,
+                prompt_len=int(len(prompt)),
+                max_new_tokens=int(max_new_tokens))
+            if self._lifecycle.verbose_loop_events:
+                self._lifecycle.record(req.rid, "QUEUED", depth=depth)
         return req.future
 
     def submit_payload(self, payload: Any) -> Future:
@@ -1070,6 +1095,8 @@ class ContinuousScheduler:
         # scheduler lock.
         if parked and self._tier_pool is not None:
             self._tier_pool.drop(rid)
+        if self._lifecycle is not None:
+            self._lifecycle.record(rid, "CANCELLED", parked=parked)
         queued.future.cancel()
         return True
 
@@ -1195,6 +1222,14 @@ class ContinuousScheduler:
                 "swap_out_bytes_total", "swap_in_bytes_total",
                 "swap_bytes_total", "swap_outs_total", "swap_ins_total",
                 "swap_dropped_total")}
+        # Lifecycle attribution: the recorder has its own lock, read it
+        # before the scheduler lock (same discipline as compile_stats /
+        # tier_stats).  The zero dict keeps the key set uniform with the
+        # recorder off.
+        if self._lifecycle is not None:
+            lifecycle_stats = self._lifecycle.stats()
+        else:
+            lifecycle_stats = dict(EMPTY_LIFECYCLE_STATS)
         with self._lock:
             lat = sorted(self._latencies_ms)
             ttft = sorted(self._ttft_ms)
@@ -1328,6 +1363,7 @@ class ContinuousScheduler:
                     if (self._deadline_met + self._deadline_missed)
                     else 0.0),
                 **tier_stats,
+                **lifecycle_stats,
             }
 
     def close(self, timeout: float = 30.0) -> None:
@@ -1788,6 +1824,12 @@ class ContinuousScheduler:
                       "path": "swap" if swapped_bytes >= 0
                       else "recompute",
                       "swap_bytes": max(swapped_bytes, 0)})
+        if self._lifecycle is not None:
+            self._lifecycle.record(
+                req.rid, "PREEMPTED",
+                path="swap" if swapped_bytes >= 0 else "recompute",
+                swap_bytes=max(swapped_bytes, 0),
+                tokens=len(req.tokens))
         with self._lock:
             self._reserved[shard] -= req.reserved_blocks
             req.reserved_blocks = 0
@@ -1882,6 +1924,10 @@ class ContinuousScheduler:
             self._obs["admissions"].inc()
             self._obs["swap_in_bytes"].inc(int(entry.bytes))
             self._obs["active_slots"].set(len(self._active))
+        if self._lifecycle is not None:
+            self._lifecycle.record(
+                req.rid, "RESUMED", path="swap",
+                swap_bytes=int(entry.bytes))
         logger.debug(
             "resumed request %d into slot %d by swap restore "
             "(%d shared + %d private block(s))",
@@ -1994,6 +2040,12 @@ class ContinuousScheduler:
                     "queue_wait", cat="serve", tid=req.rid,
                     start=req.submitted, end=admitted_at,
                     args={"request_id": req.rid, "slot": req.slot})
+                # Finish the per-rid flow the gateway started: Perfetto
+                # draws the arrow from the gateway's lane into this
+                # request's scheduler lane.
+                self._tracer.add_flow(
+                    "request", id=req.rid, phase="f", cat="serve",
+                    tid=req.rid, t=admitted_at)
                 if req.blocked_since is not None:
                     self._tracer.add_span(
                         "reservation_wait", cat="serve", tid=req.rid,
@@ -2017,6 +2069,11 @@ class ContinuousScheduler:
                 self._obs["active_slots"].set(len(self._active))
                 self._obs["prefilling_slots"].set(self._prefilling)
                 self._obs["prefill_backlog"].set(self._prefill_backlog)
+            if self._lifecycle is not None:
+                self._lifecycle.record(
+                    req.rid, "ADMITTED", t=admitted_at, slot=req.slot,
+                    prefix_cached=start,
+                    readmission=req.preemptions)
             logger.debug("admitted request into slot %d (prompt %d, "
                          "cached %d)", req.slot, len(req.prompt), start)
 
@@ -2088,6 +2145,15 @@ class ContinuousScheduler:
             spent += chunk
             req.next_prefill_offset = off + chunk
             req.prefill_chunks += 1
+            if (self._lifecycle is not None
+                    and self._lifecycle.verbose_loop_events):
+                # Export-only: chunk boundaries colour the JSONL trace;
+                # the fold's prefill phase keys off ADMITTED ->
+                # FIRST_TOKEN alone.
+                self._lifecycle.record(
+                    req.rid, "PREFILL_CHUNK", offset=int(off),
+                    chunk_tokens=int(chunk),
+                    chunk_index=int(req.prefill_chunks - 1))
             first_decoded = False
             deferred = final and self.async_decode
             if deferred:
@@ -2117,6 +2183,10 @@ class ContinuousScheduler:
                 first_decoded = req.first_token_at is None
                 if first_decoded:
                     req.first_token_at = now
+                    if self._lifecycle is not None:
+                        self._lifecycle.record(
+                            req.rid, "FIRST_TOKEN", t=now,
+                            chunks=int(req.prefill_chunks))
                 req.last_token_at = now
                 req.tokens.append(tok)
                 self._last_tok[req.slot, 0] = tok
@@ -2309,6 +2379,8 @@ class ContinuousScheduler:
                       "generations": len(by_gen)})
         step_done = time.monotonic()
         gaps = []
+        lc_batch = [] if self._lifecycle is not None else None
+        to_retire = []
         for slot in active_slots:
             req = decoding[slot]
             tok = toks_by_slot[slot]
@@ -2317,9 +2389,15 @@ class ContinuousScheduler:
             if req.last_token_at is not None:
                 gaps.append((step_done - req.last_token_at) * 1000.0)
             req.last_token_at = step_done
-            self._emit_tokens(req)
+            self._emit_tokens(req, t=step_done, dispatch_t=iter_start,
+                              batch=lc_batch)
             if req.done():
-                self._retire(req)
+                to_retire.append(req)
+        if lc_batch:
+            self._lifecycle.record_tokens_batch(
+                lc_batch, t=step_done, dispatch_t=iter_start)
+        for req in to_retire:
+            self._retire(req)
         with self._lock:
             self._tpot_gaps_ms.extend(gaps)
             self._megastep_launches += len(launches)
@@ -2452,6 +2530,13 @@ class ContinuousScheduler:
                 start=dispatch_t, end=time.monotonic(),
                 args={"active_slots": len(active_slots),
                       "generations": len(by_gen), "megastep": K})
+        if self._lifecycle is not None and self._lifecycle.verbose_loop_events:
+            # Loop-level event (rid 0): launch cadence for the JSONL
+            # export; the per-request attribution rides the
+            # TOKEN_STREAMED context instead.
+            self._lifecycle.record(
+                0, "MEGASTEP_DISPATCH", t=dispatch_t, steps=int(K),
+                active_slots=len(active_slots), seq=int(seq))
         return _InflightMegastep(
             launches=launches, decoding=decoding,
             base_len={s: len(decoding[s].tokens) + prev_pending.get(s, 0)
@@ -2485,7 +2570,7 @@ class ContinuousScheduler:
         per inner step, not an equal share of the host's observation
         gap (which, async, includes a whole iteration of host work)."""
         K = rec.steps
-        (outs_host, clock_host), fetch_done = self._rec_result(rec)
+        (outs_host, clock_host), fetch_done, waited = self._rec_result(rec)
         fetched = [(slots, toks, int(steps))
                    for (slots, _, _), (toks, steps)
                    in zip(rec.launches, outs_host)]
@@ -2495,10 +2580,16 @@ class ContinuousScheduler:
                 "fetch", cat="serve", tid=0,
                 start=rec.dispatch_t, end=fetch_done,
                 args={"megastep": K, "launches": len(rec.launches)})
+        if self._lifecycle is not None and self._lifecycle.verbose_loop_events:
+            self._lifecycle.record(
+                0, "MEGASTEP_FETCH", t=fetch_done, steps=int(K),
+                seq=int(rec.seq), wait_s=round(waited, 6))
         span = max(fetch_done - rec.dispatch_t, 0.0)
         gaps: List[float] = []
         appended = 0
         effective = 0
+        lc_batch = [] if self._lifecycle is not None else None
+        to_retire: List[_SlotRequest] = []
         for slots, toks, steps_run in fetched:
             effective += steps_run
             per_step = span / max(steps_run, 1)
@@ -2520,9 +2611,19 @@ class ContinuousScheduler:
                 appended += n
                 if n:
                     self._last_tok[slot, 0] = req.tokens[-1]
-                    self._emit_tokens(req)
+                    self._emit_tokens(
+                        req, t=fetch_done, dispatch_t=rec.dispatch_t,
+                        wait_s=waited, batch=lc_batch)
                 if req.done():
-                    self._retire(req)
+                    to_retire.append(req)
+        # Flush deferred TOKEN_STREAMED folds BEFORE retiring: RETIRED
+        # finalizes a request's fold, so its last tokens must land first.
+        if lc_batch:
+            self._lifecycle.record_tokens_batch(
+                lc_batch, t=fetch_done, dispatch_t=rec.dispatch_t,
+                wait_s=waited)
+        for req in to_retire:
+            self._retire(req)
         self._step_s.append(span / max(effective, 1))
         with self._lock:
             self._device_clock = clock_now
@@ -2567,8 +2668,12 @@ class ContinuousScheduler:
         else:
             self._megastep_fetch(rec)
 
-    def _rec_result(self, rec) -> Tuple[Any, float]:
-        """A ring record's host payload plus its fetch-done timestamp.
+    def _rec_result(self, rec) -> Tuple[Any, float, float]:
+        """A ring record's host payload, its fetch-done timestamp, and
+        the loop-thread seconds THIS resolve spent blocked on the fetch
+        thread (0.0 on the inline path) — the per-record share of
+        ``async_fetch_wait_s``, which the lifecycle fold attributes to
+        the resolving requests as ``fetch_wait``.
 
         Enqueued records resolve on the fetch thread: block on the
         record's Future — accounting the wait, the residual fetch
@@ -2582,10 +2687,11 @@ class ContinuousScheduler:
         if rec.enqueued:
             t0 = time.monotonic()
             out, t_done = rec.fetched.result()
+            waited = time.monotonic() - t0
             with self._lock:
-                self._fetch_wait_s += time.monotonic() - t0
-            return out, t_done
-        return self._fetch_host(rec.fetch_payload), time.monotonic()
+                self._fetch_wait_s += waited
+            return out, t_done, waited
+        return self._fetch_host(rec.fetch_payload), time.monotonic(), 0.0
 
     def _enqueue_fetch(self, rec) -> None:
         """Hand a just-dispatched record to the fetch thread (lazily
@@ -2840,6 +2946,8 @@ class ContinuousScheduler:
         appended = 0
         accepted_total = 0
         consumed = 1
+        lc_batch = [] if self._lifecycle is not None else None
+        to_retire = []
         for slots, targets, accepted in fetched:
             for slot in slots:
                 req = decoding[slot]
@@ -2860,9 +2968,16 @@ class ContinuousScheduler:
                     gaps.extend([per] * n)
                 req.last_token_at = step_done
                 if n:
-                    self._emit_tokens(req)
+                    self._emit_tokens(
+                        req, t=step_done, dispatch_t=iter_start,
+                        batch=lc_batch)
                 if req.done():
-                    self._retire(req)
+                    to_retire.append(req)
+        if lc_batch:
+            self._lifecycle.record_tokens_batch(
+                lc_batch, t=step_done, dispatch_t=iter_start)
+        for req in to_retire:
+            self._retire(req)
         drafted_total = int(draft_lens.sum())
         with self._lock:
             if len(launches) == 1:
@@ -3005,7 +3120,7 @@ class ContinuousScheduler:
         retirement as the sync spec path, one ring position later.  A
         slot that retired at an earlier fetch is skipped whole (zombie
         tail — the megastep fetch's contract)."""
-        (outs_host, clock_host), fetch_done = self._rec_result(rec)
+        (outs_host, clock_host), fetch_done, waited = self._rec_result(rec)
         fetched = [(slots, targets, accepted)
                    for (slots, _, _), (targets, accepted)
                    in zip(rec.launches, outs_host)]
@@ -3019,6 +3134,8 @@ class ContinuousScheduler:
         emitted_per_slot: List[int] = []
         appended = 0
         accepted_total = 0
+        lc_batch = [] if self._lifecycle is not None else None
+        to_retire = []
         for slots, targets, accepted in fetched:
             for slot in slots:
                 req = rec.decoding[slot]
@@ -3041,9 +3158,17 @@ class ContinuousScheduler:
                                * 1000.0 / n)
                         gaps.extend([per] * n)
                     req.last_token_at = fetch_done
-                    self._emit_tokens(req)
+                    self._emit_tokens(
+                        req, t=fetch_done, dispatch_t=rec.dispatch_t,
+                        wait_s=waited, batch=lc_batch)
                 if req.done():
-                    self._retire(req)
+                    to_retire.append(req)
+        if lc_batch:
+            self._lifecycle.record_tokens_batch(
+                lc_batch, t=fetch_done, dispatch_t=rec.dispatch_t,
+                wait_s=waited)
+        for req in to_retire:
+            self._retire(req)
         drafted_total = sum(rec.draft_lens.values())
         with self._lock:
             self._device_clock = clock_now
@@ -3077,7 +3202,7 @@ class ContinuousScheduler:
         TTFB stamp at resolve (when the token actually became host-
         visible); the slot joins the decode-active set at the NEXT
         dispatch via the fresh-row merge."""
-        host, fetch_done = self._rec_result(rec)
+        host, fetch_done, _waited = self._rec_result(rec)
         req = rec.req
         if req.finished_at is not None:
             return  # retired while the chunk was in flight
@@ -3087,6 +3212,10 @@ class ContinuousScheduler:
         first_decoded = req.first_token_at is None
         if first_decoded:
             req.first_token_at = fetch_done
+            if self._lifecycle is not None:
+                self._lifecycle.record(
+                    req.rid, "FIRST_TOKEN", t=fetch_done,
+                    chunks=int(req.prefill_chunks), deferred=True)
         req.last_token_at = fetch_done
         req.tokens.append(tok)
         self._last_tok[req.slot, 0] = tok
@@ -3116,7 +3245,11 @@ class ContinuousScheduler:
             self._decode_counter += count
             return self._decode_counter - count + 1
 
-    def _emit_tokens(self, req: _SlotRequest) -> None:
+    def _emit_tokens(self, req: _SlotRequest, *,
+                     t: Optional[float] = None,
+                     dispatch_t: Optional[float] = None,
+                     wait_s: float = 0.0,
+                     batch: Optional[List] = None) -> None:
         """Deliver ``req``'s not-yet-streamed tokens to its ``on_token``
         callback (loop thread, right after each host fetch appends them).
 
@@ -3128,7 +3261,18 @@ class ContinuousScheduler:
         scheduler lock across foreign code invites deadlock.  TTFB is
         stamped at the first delivery (for every request, streaming or
         not — the non-streaming TTFB is what a gateway client would have
-        seen)."""
+        seen).
+
+        ``t``/``dispatch_t``/``wait_s`` are the lifecycle fold's launch
+        context from the resolving fetch site: the tokens' landing time,
+        the launch's dispatch time, and the loop-thread seconds the
+        resolve blocked on the fetch thread.  All host values the caller
+        already had — the fold splits the request's progress gap into
+        decode_compute / fetch_wait / scheduler_stall from them.  Loop
+        sites that resolve several slots in one fetch pass ``batch`` (a
+        list): the lifecycle record is deferred to ONE
+        ``record_tokens_batch`` call after the loop, so the recorder's
+        lock is paid per fetch, not per slot."""
         with self._lock:
             if req.cancelled:
                 return
@@ -3142,6 +3286,13 @@ class ContinuousScheduler:
                 self._ttfb_ms.append(ttfb_s * 1e3)
                 self._obs["ttfb"].observe(ttfb_s)
             cb = req.on_token
+        if self._lifecycle is not None:
+            if batch is not None:
+                batch.append((req.rid, len(new)))
+            else:
+                self._lifecycle.record_tokens(
+                    req.rid, t=t, n=len(new), dispatch_t=dispatch_t,
+                    wait_s=wait_s)
         if cb is None:
             return
         try:
@@ -3242,6 +3393,11 @@ class ContinuousScheduler:
                             / (len(req.tokens) - 1))
             # Wake drain() waiters when the last resident slot retires.
             self._cond.notify_all()
+        if self._lifecycle is not None:
+            self._lifecycle.record(
+                req.rid, "CANCELLED" if was_cancelled else "RETIRED",
+                t=req.finished_at, tokens=len(req.tokens),
+                preemptions=req.preemptions)
         if req.gen is not None:
             # Generation tag rides the Future: callers (and the fleet
             # hot-reload tests) can assert which weights produced this

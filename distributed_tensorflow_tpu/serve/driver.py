@@ -200,6 +200,20 @@ class ServeArgs:
     # "" = tracing off; a path enables the flight recorder and writes the
     # Chrome trace-event JSON (Perfetto-loadable) there at shutdown.
     trace_out: str = ""
+    # "" = the synthetic closed-loop client mix above; a trace spec
+    # ("poisson:n=64,whale_frac=0.2" / "diurnal:..." / "burst:...")
+    # replaces it with the OPEN-LOOP load generator (serve/loadgen.py):
+    # arrivals fire on schedule whether or not earlier requests
+    # finished, 429s count as real shed, and the JSON line reports
+    # goodput-under-SLO.  Requires the continuous gpt2 path.
+    loadgen_trace: str = ""
+    # Mean arrival rate (req/s) for --loadgen_trace specs that don't
+    # pin their own rate=.
+    arrival_rate: float = 8.0
+    # "" = lifecycle attribution off; a path attaches the per-request
+    # LifecycleRecorder (obs/lifecycle.py) and streams its typed events
+    # there as JSONL.  The JSON line gains the per-phase breakdown keys.
+    lifecycle_log: str = ""
 
 
 def _auto_preset(args: ServeArgs) -> Optional[str]:
@@ -358,7 +372,8 @@ def run_serve(args: ServeArgs,
             engine.close()
 
 
-def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
+def _make_batcher(args: ServeArgs, engine: ServeEngine,
+                  lifecycle=None) -> DynamicBatcher:
     """The scheduling discipline behind one run: fixed buckets or
     iteration-level streaming into a continuous scheduler."""
     if args.model != "gpt2":
@@ -387,6 +402,7 @@ def _make_batcher(args: ServeArgs, engine: ServeEngine) -> DynamicBatcher:
             async_depth=args.async_depth,
             spec_k=args.spec_k or None,
             spec_ngram=args.spec_ngram,
+            lifecycle=lifecycle,
             **_slo_kwargs(args),
             **_cache_kwargs(args),
         )
@@ -589,6 +605,98 @@ def _warm(args: ServeArgs, engine: ServeEngine, payloads) -> None:
     del gen
 
 
+_BREAKDOWN_PHASES = ("queue_wait", "prefill", "decode_compute",
+                     "fetch_wait", "swap", "scheduler_stall")
+
+
+def _lifecycle_keys(stats: Dict[str, float], args: ServeArgs
+                    ) -> Dict[str, Any]:
+    """Per-phase attribution keys for the JSON line (the scheduler's
+    ``stats()`` already merged the recorder's aggregates)."""
+    out: Dict[str, Any] = {
+        "lifecycle_requests_total": int(
+            stats.get("lifecycle_requests_total", 0.0)),
+        "lifecycle_events_total": int(
+            stats.get("lifecycle_events_total", 0.0)),
+        "lifecycle_dropped_total": int(
+            stats.get("lifecycle_dropped_total", 0.0)),
+        "breakdown_sum_to_wall_ratio": round(
+            stats.get("breakdown_sum_to_wall_ratio", 0.0), 4),
+    }
+    for ph in _BREAKDOWN_PHASES:
+        out[f"breakdown_{ph}_p99_ms"] = round(
+            stats.get(f"breakdown_{ph}_p99_ms", 0.0), 3)
+    for ph in ("queue_wait", "prefill", "swap"):
+        out[f"ttft_breakdown_{ph}_p99_ms"] = round(
+            stats.get(f"ttft_breakdown_{ph}_p99_ms", 0.0), 3)
+    if args.lifecycle_log:
+        out["lifecycle_log"] = args.lifecycle_log
+    return out
+
+
+def _drive_loadgen(args: ServeArgs, engine: ServeEngine, batcher,
+                   monitor, *, gateway=None, lifecycle=None
+                   ) -> Dict[str, Any]:
+    """Open-loop trace replay: the loadgen arrival process replaces the
+    closed-loop synthetic clients, so overload shows up as shed + missed
+    SLOs in the JSON line instead of a quietly degraded arrival rate."""
+    from distributed_tensorflow_tpu.serve import loadgen as loadgen_lib
+
+    cfg = engine.module.cfg
+    # Same capacity the batcher was sized for: prompts clamp to it.
+    need = max(p.shape[0] + m for p, m in
+               map(_payload_parts,
+                   _make_requests(args, engine, np.random.default_rng(0))))
+    kwargs = loadgen_lib.parse_trace_spec(
+        args.loadgen_trace, rate=args.arrival_rate, seed=args.seed)
+    n = int(kwargs.pop("n"))
+    kwargs.setdefault("vocab", int(cfg.vocab_size))
+    kwargs.setdefault("max_total_len", min(cfg.n_positions, need))
+    trace = loadgen_lib.build_trace(n, **kwargs)
+    compile_warm = engine.compile_stats()["compile_total"]
+    report = loadgen_lib.run_trace(
+        batcher.scheduler, trace, lifecycle=lifecycle)
+    stats = batcher.stats()
+    gstats = None
+    if gateway is not None:
+        gstats = gateway.stats()
+        gateway.close(timeout=args.drain_timeout_s)
+    batcher.close()
+    monitor.log(n)
+    cstats = engine.compile_stats()
+    out: Dict[str, Any] = {
+        "model": args.model,
+        "scheduler": "continuous",
+        "loadgen_trace": args.loadgen_trace,
+        "arrival_rate": float(kwargs.get("rate", args.arrival_rate)),
+        "requests": int(report["requests_total"]),
+        "completed": int(report["completed"]),
+        "shed": int(report["shed"]),
+        "errors": int(report["errors"]),
+        "shed_rate": round(report["shed_rate"], 4),
+        "goodput_under_slo": round(report["goodput_under_slo"], 4),
+        "goodput_requests": int(report["goodput_requests"]),
+        "tokens_generated": int(report["tokens_emitted"]),
+        "tokens_per_sec": round(report["tokens_per_sec"], 2),
+        "elapsed_s": round(report["wall_s"], 4),
+        "client_ttft_p50_ms": round(report["client_ttft_p50_ms"], 3),
+        "client_ttft_p99_ms": round(report["client_ttft_p99_ms"], 3),
+        "tokens_checksum": report["tokens_checksum"],
+        "by_tier": report["by_tier"],
+        "by_scenario": report["by_scenario"],
+        "slo_scheduling": bool(args.slo_scheduling),
+        "checkpoint_step": engine.restored_step,
+        "compile_total": int(cstats["compile_total"]),
+        "compile_post_warmup": int(cstats["compile_total"] - compile_warm),
+    }
+    out.update(_lifecycle_keys(stats, args))
+    if gstats is not None:
+        out["gateway_port"] = int(args.gateway_port)
+        out["gateway_accepted"] = int(gstats["gateway_accepted"])
+        out["gateway_throttled"] = int(gstats["gateway_throttled"])
+    return out
+
+
 def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
     if args.sampling_mix and not (args.model == "gpt2" and args.continuous):
         raise ValueError(
@@ -601,6 +709,20 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             "--slo_scheduling requires the continuous gpt2 path "
             "(--continuous); fixed-batch scheduling has no admission "
             "ranking or preemption")
+    lifecycle = None
+    if args.loadgen_trace or args.lifecycle_log:
+        if not (args.model == "gpt2" and args.continuous
+                and args.num_replicas == 1):
+            raise ValueError(
+                "--loadgen_trace / --lifecycle_log require the "
+                "single-replica continuous gpt2 path (--continuous): "
+                "the open-loop harness and the lifecycle hooks drive "
+                "the iteration-level scheduler directly")
+        from distributed_tensorflow_tpu.obs.lifecycle import (
+            LifecycleRecorder,
+        )
+
+        lifecycle = LifecycleRecorder(jsonl_path=args.lifecycle_log or None)
     rng = np.random.default_rng(args.seed)
     payloads = _make_requests(args, engine, rng)
     megastep_auto = args.megastep == "auto"
@@ -622,7 +744,7 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             _warm(args, rep.engine, payloads)
     else:
         _warm(args, engine, payloads)
-        batcher = _make_batcher(args, engine)
+        batcher = _make_batcher(args, engine, lifecycle=lifecycle)
     gateway = None
     if args.gateway_port:
         from distributed_tensorflow_tpu.serve.gateway import GatewayServer
@@ -638,6 +760,13 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
             gateway.host, gateway.port, args.max_inflight,
             args.priority_headroom)
     monitor = ServeMonitorHook(batcher, every_steps=args.log_every)
+    if args.loadgen_trace:
+        try:
+            return _drive_loadgen(args, engine, batcher, monitor,
+                                  gateway=gateway, lifecycle=lifecycle)
+        finally:
+            if lifecycle is not None:
+                lifecycle.close()
     futures: List[Any] = [None] * len(payloads)
     rejected = [0]
     lock = threading.Lock()
@@ -712,6 +841,8 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
         gateway.close(timeout=args.drain_timeout_s)
     batcher.close()
     monitor.log(len(payloads))
+    if lifecycle is not None:
+        lifecycle.close()
 
     completed = int(stats["completed"])
     out: Dict[str, Any] = {
@@ -802,6 +933,8 @@ def _drive(args: ServeArgs, engine: ServeEngine) -> Dict[str, Any]:
                 stats.get("deadline_missed_total", 0.0))
             out["deadline_goodput"] = round(
                 stats.get("deadline_goodput", 0.0), 4)
+        if lifecycle is not None:
+            out.update(_lifecycle_keys(stats, args))
         out["cache_mode"] = args.cache_mode
         out["kv_dtype"] = args.kv_dtype or None
         if args.cache_mode == "paged":

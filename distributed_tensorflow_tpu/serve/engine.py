@@ -381,6 +381,13 @@ class ServeEngine:
             return (0.0, 0)
         return (float(temperature), max(0, int(top_k)))
 
+    def set_lifecycle(self, lifecycle) -> None:
+        """Attach a lifecycle recorder (``obs.lifecycle``): every
+        program-cache miss records a rid-0 COMPILE event, so a bench
+        asserting ``compile_post_warmup == 0`` can cross-check the
+        lifecycle stream instead of trusting the counter alone."""
+        self._lifecycle = lifecycle
+
     def _note_compile(self, kind: str) -> None:
         """Account one program-cache miss: the per-kind labelled counter
         plus the total the bench A/B asserts stays flat post-warmup.
@@ -391,6 +398,9 @@ class ServeEngine:
         self._obs["compiles"].labels(kind=kind).inc()
         self._obs["compile_total"].inc()
         self._obs["programs_cached"].inc()
+        lifecycle = getattr(self, "_lifecycle", None)
+        if lifecycle is not None:
+            lifecycle.record(0, "COMPILE", program=kind)
 
     def compile_stats(self) -> Dict[str, float]:
         """Compile/program-cache telemetry snapshot.  Reads

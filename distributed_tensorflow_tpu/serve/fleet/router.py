@@ -289,6 +289,8 @@ class FleetRouter:
         "preempted_pending", "swapped_resident", "swapped_bytes_resident",
         "swap_out_bytes_total", "swap_in_bytes_total", "swap_bytes_total",
         "deadline_met_total", "deadline_missed_total",
+        "lifecycle_requests_total", "lifecycle_events_total",
+        "lifecycle_dropped_total",
     )
     _MAX_KEYS = (
         "p50_latency_ms", "p99_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
@@ -298,6 +300,19 @@ class FleetRouter:
         "blocks_per_request_mean", "block_size", "kv_hbm_bytes_per_shard",
         "param_generation", "prefill_budget", "megastep", "spec_k",
         "async_decode", "device_idle_fraction", "slo_scheduling",
+        "lifecycle_enabled", "breakdown_sum_to_wall_ratio",
+        "breakdown_wall_p50_ms", "breakdown_wall_p99_ms",
+        "breakdown_queue_wait_p50_ms", "breakdown_queue_wait_p99_ms",
+        "breakdown_prefill_p50_ms", "breakdown_prefill_p99_ms",
+        "breakdown_decode_compute_p50_ms", "breakdown_decode_compute_p99_ms",
+        "breakdown_fetch_wait_p50_ms", "breakdown_fetch_wait_p99_ms",
+        "breakdown_swap_p50_ms", "breakdown_swap_p99_ms",
+        "breakdown_scheduler_stall_p50_ms",
+        "breakdown_scheduler_stall_p99_ms",
+        "ttft_breakdown_queue_wait_p50_ms",
+        "ttft_breakdown_queue_wait_p99_ms",
+        "ttft_breakdown_prefill_p50_ms", "ttft_breakdown_prefill_p99_ms",
+        "ttft_breakdown_swap_p50_ms", "ttft_breakdown_swap_p99_ms",
     )
 
     def stats(self) -> Dict[str, float]:

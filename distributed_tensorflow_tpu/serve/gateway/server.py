@@ -44,12 +44,14 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from distributed_tensorflow_tpu.obs import metrics as obs_metrics
+from distributed_tensorflow_tpu.obs.trace import default_tracer
 from distributed_tensorflow_tpu.serve import sampling as sampling_lib
 from distributed_tensorflow_tpu.serve.batcher import ServeOverloadedError
 from distributed_tensorflow_tpu.serve.gateway.cancel import CancelRegistry
@@ -217,10 +219,20 @@ class GatewayServer:
         gid = self._registry.register(
             fut, stream=ts,
             canceller=lambda: self._cancel_backend(fut))
+        open_t = time.monotonic()
+        tracer = default_tracer()
+        rid = getattr(fut, "rid", None)
+        if tracer.enabled and rid is not None:
+            # Start the per-rid flow: the scheduler's admission finishes
+            # it, so Perfetto draws gateway lane -> scheduler lane per
+            # request.  A gateway span closes the lane at _finish.
+            tracer.add_flow("request", id=int(rid), phase="s",
+                            cat="gateway", tid=int(rid), t=open_t)
         eos = payload.get("eos_token")
         want = payload.get("max_new_tokens")
         fut.add_done_callback(
-            lambda f: self._finish(gid, f, ts, eos, want))
+            lambda f: self._finish(gid, f, ts, eos, want,
+                                   open_t=open_t, rid=rid))
         with self._lock:
             self._accepted += 1
             tier = int(priority)
@@ -244,11 +256,19 @@ class GatewayServer:
         return bool(self._backend.cancel(rid))
 
     def _finish(self, gid: str, fut, ts: Optional[TokenStream],
-                eos_token, max_new_tokens) -> None:
+                eos_token, max_new_tokens, *,
+                open_t: Optional[float] = None,
+                rid: Optional[int] = None) -> None:
         """Future done callback (decode loop thread, or the cancelling
         thread): land the final stream event, release the registration,
         and free the inflight seat.  Must never raise and never call
         into the scheduler."""
+        tracer = default_tracer()
+        if tracer.enabled and open_t is not None and rid is not None:
+            tracer.add_span(
+                "gateway", cat="gateway", tid=int(rid),
+                start=open_t, end=time.monotonic(),
+                args={"gid": gid, "request_id": int(rid)})
         try:
             if ts is not None:
                 ts.finish(self._final_event(

@@ -124,10 +124,15 @@ class HostKVPool:
     ``swapped_rids()`` readers.
     """
 
-    def __init__(self, engine, *, paged, policy: Optional[SwapPolicy] = None):
+    def __init__(self, engine, *, paged, policy: Optional[SwapPolicy] = None,
+                 lifecycle=None):
         self.engine = engine
         self.paged = paged
         self.policy = policy or SwapPolicy()
+        # Lifecycle tap (obs.lifecycle.LifecycleRecorder or None): swap
+        # traffic records SWAPPED_OUT/SWAPPED_IN with host-side byte
+        # counts the ledger already computed.
+        self._lifecycle = lifecycle
         self._lock = threading.Lock()
         self._ledger: Dict[int, SwappedRequest] = {}
         self._swap_out_bytes = 0
@@ -161,6 +166,10 @@ class HostKVPool:
             self._ledger[rid] = entry
             self._swap_out_bytes += moved
             self._swap_outs += 1
+        if self._lifecycle is not None:
+            self._lifecycle.record(
+                rid, "SWAPPED_OUT", swap_bytes=moved,
+                blocks=len(private_blocks), shared_blocks=shared_blocks)
         return entry
 
     # -- swap in --------------------------------------------------------------
@@ -183,6 +192,10 @@ class HostKVPool:
         with self._lock:
             self._swap_in_bytes += entry.bytes
             self._swap_ins += 1
+        if self._lifecycle is not None:
+            self._lifecycle.record(
+                rid, "SWAPPED_IN", swap_bytes=int(entry.bytes),
+                blocks=len(blocks))
         return cache
 
     def restore_counts(self, counts, *, rid: int, slot: int):

@@ -15,6 +15,12 @@
 # present) rides tier-1: a lint finding fails the gate even when every
 # test passes, but never masks a test failure's exit code.
 #
+# DTT_SERVE_LOADGEN=1 adds an opt-in open-loop load-harness smoke AFTER
+# the gate: a short seeded Poisson trace replays through serve.py with
+# the lifecycle recorder attached (--loadgen_trace + --lifecycle_log),
+# proving the goodput/breakdown JSON keys end to end.  Opt-in for the
+# same reason as the async pass: it pays a cold-jit entrypoint run.
+#
 # DTT_SERVE_ASYNC=1 adds an opt-in deep-async pass AFTER the gate: the
 # serve_slow async suites rerun with the launch ring at depth 4
 # (DTT_ASYNC_DEPTH=4 — three launches in flight behind every fetch),
@@ -25,6 +31,17 @@ cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow and not serve_slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 bash scripts/lint.sh; lint_rc=$?
 [ "$rc" -eq 0 ] && rc=$lint_rc
+if [ "${DTT_SERVE_LOADGEN:-0}" = "1" ]; then
+  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python serve.py --model=gpt2 --continuous \
+    --loadgen_trace=poisson:n=12,rate=50 \
+    --lifecycle_log=/tmp/_t1_lifecycle.jsonl \
+    | python -c 'import json,sys; r=json.load(sys.stdin); \
+assert "goodput_under_slo" in r and "shed_rate" in r \
+and "breakdown_sum_to_wall_ratio" in r, sorted(r); \
+print("LOADGEN_GOODPUT=%.3f" % r["goodput_under_slo"])'; loadgen_rc=$?
+  [ "$rc" -eq 0 ] && rc=$loadgen_rc
+fi
 if [ "${DTT_SERVE_ASYNC:-0}" = "1" ]; then
   timeout -k 10 1800 env JAX_PLATFORMS=cpu DTT_ASYNC_DEPTH=4 \
     python -m pytest tests/test_serve_async.py -q -m serve_slow \

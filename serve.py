@@ -36,6 +36,9 @@ Examples:
         --max_inflight=32     # HTTP/SSE front door + admission control
     python serve.py --model=gpt2 --continuous --cache_mode=paged \
         --slo_scheduling --num_blocks=24    # SLO tiers + KV swap-to-host
+    python serve.py --model=gpt2 --continuous --cache_mode=paged \
+        --slo_scheduling --loadgen_trace=poisson:n=64,rate=12 \
+        --lifecycle_log=/tmp/lifecycle.jsonl  # open-loop goodput harness
 
 SIGTERM (and Ctrl-C) triggers a graceful drain: no new admissions,
 in-flight decodes finish (bounded by --drain_timeout_s), queued requests
@@ -268,6 +271,25 @@ def parse_args(argv=None):
                    help="write a Chrome trace-event JSON (per-request "
                         "queue/prefill/decode spans; load in Perfetto) "
                         "here at shutdown ('' = tracing off)")
+    p.add_argument("--loadgen_trace", default=defaults.loadgen_trace,
+                   help="open-loop load harness (requires --continuous): "
+                        "an arrival-trace spec 'process:k=v,...' where "
+                        "process is poisson|diurnal|burst and k=v pairs "
+                        "override build_trace keywords, e.g. "
+                        "'poisson:n=64,rate=12,whale_frac=0.2' — replaces "
+                        "the closed-loop synthetic clients, counts 429s "
+                        "as real shed, and reports goodput-under-SLO "
+                        "('' = off)")
+    p.add_argument("--arrival_rate", type=float,
+                   default=defaults.arrival_rate,
+                   help="mean arrival rate (req/s) for --loadgen_trace "
+                        "specs that don't pin their own rate=")
+    p.add_argument("--lifecycle_log", default=defaults.lifecycle_log,
+                   help="attach the per-request lifecycle recorder and "
+                        "stream its typed events (SUBMIT/ADMITTED/"
+                        "FIRST_TOKEN/PREEMPTED/...) here as JSONL; the "
+                        "JSON line gains per-phase breakdown keys "
+                        "('' = off)")
     return ServeArgs(**vars(p.parse_args(argv)))
 
 

@@ -1,0 +1,60 @@
+"""Seeded lifecycle-recorder defects, one per rule family:
+
+- ``EventLog`` appends to its event list from the exporter's background
+  drain thread and snapshots it from the main (stats) thread with no
+  lock anywhere — the shape of a per-request lifecycle recorder shared
+  between a JSONL export thread and the scheduler's ``stats()``.
+  ``cross-thread-race`` must report the write site.
+- ``DecodeLoop._record_token`` is the lifecycle tap gone wrong: it
+  folds the freshly stepped DEVICE token into the breakdown with
+  ``float(...)`` — an implicit per-iteration device sync smuggled in
+  through an innocent-looking observability hook.  The real recorder
+  (``obs/lifecycle.py``) takes HOST scalars the loop already fetched;
+  ``host-sync`` exists to catch exactly this regression.
+
+Lines are tagged ``# SEED: <rule-id>`` so each rule family only claims
+its own lines when both run over this module.
+"""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class EventLog:
+    def __init__(self):
+        self.events = []
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            self.events += [("RETIRED", 0.0)]  # SEED: cross-thread-race
+
+    def snapshot(self):
+        return list(self.events)
+
+
+class DecodeLoop:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, tok: tok)
+        self._last_tok = None
+        self.breakdown = []
+
+    def _record_token(self) -> None:
+        # Hot because decode's iteration loop calls it — and the value
+        # it "just logs" is still resident on device.
+        self.breakdown.append(float(self._last_tok[0]))  # SEED: host-sync
+
+    def decode(self, tok, steps):
+        for _ in range(steps):
+            with _launch_lock:
+                tok = self._step(self.params, tok)
+            self._last_tok = tok
+            self._record_token()
+        return tok

@@ -1,0 +1,49 @@
+"""Clean twin of ``lifecycle_bad``: the drain-thread append and the
+stats-thread snapshot of the event list share one lock, and the
+lifecycle tap records only HOST scalars fetched through ONE explicit
+``jax.device_get`` point per iteration — the sanctioned tap discipline
+``obs/lifecycle.py`` documents.  Zero findings expected."""
+
+import threading
+
+import jax
+
+_launch_lock = threading.Lock()
+
+
+class EventLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                self.events += [("RETIRED", 0.0)]
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.events)
+
+
+class DecodeLoop:
+    def __init__(self, params):
+        self.params = params
+        self._step = jax.jit(lambda params, tok: tok)
+        self.breakdown = []
+
+    def _record_token(self, host_tok) -> None:
+        # The hook takes a HOST scalar the loop already fetched.
+        self.breakdown.append(float(host_tok))
+
+    def decode(self, tok, steps):
+        for _ in range(steps):
+            with _launch_lock:
+                tok = self._step(self.params, tok)
+            host = jax.device_get(tok)
+            self._record_token(host[0])
+        return tok

@@ -65,6 +65,7 @@ class TestSeededFixtures:
         ("race", CrossThreadRaceRule, "cross-thread-race"),
         ("gateway", CrossThreadRaceRule, "cross-thread-race"),
         ("tiering", CrossThreadRaceRule, "cross-thread-race"),
+        ("lifecycle", CrossThreadRaceRule, "cross-thread-race"),
         ("launch", CollectiveLaunchRule, "collective-launch"),
         ("megastep", CollectiveLaunchRule, "collective-launch"),
         ("spec", CollectiveLaunchRule, "collective-launch"),

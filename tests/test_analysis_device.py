@@ -54,6 +54,7 @@ CASES = [
     ("asyncring", HostSyncRule, "host-sync"),
     ("gateway", HostSyncRule, "host-sync"),
     ("tiering", HostSyncRule, "host-sync"),
+    ("lifecycle", HostSyncRule, "host-sync"),
 ]
 
 

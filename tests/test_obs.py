@@ -424,3 +424,253 @@ class TestInstrumentedComponents:
         assert rec["step"] == 7
         assert rec["dtt_j_total"] == 2
         assert rec["dtt_j_seconds_count"] == 1
+
+
+# -- lifecycle attribution ----------------------------------------------------
+
+
+class TestLifecycleRecorder:
+    """The fold is an EXACT partition: phases sum to wall for every
+    event path the scheduler can emit (plain, preempt/swap/resume,
+    never-admitted, cancelled)."""
+
+    def _rec(self, **kw):
+        from distributed_tensorflow_tpu.obs.lifecycle import (
+            LifecycleRecorder,
+        )
+
+        return LifecycleRecorder(registry=Registry(), **kw)
+
+    def test_stats_keys_match_empty_surface(self):
+        from distributed_tensorflow_tpu.obs.lifecycle import (
+            EMPTY_LIFECYCLE_STATS,
+        )
+
+        rec = self._rec()
+        assert set(rec.stats()) == set(EMPTY_LIFECYCLE_STATS)
+        assert rec.stats()["lifecycle_enabled"] == 1.0
+        assert EMPTY_LIFECYCLE_STATS["lifecycle_enabled"] == 0.0
+
+    def test_plain_request_partition_is_exact(self):
+        rec = self._rec()
+        rec.record(1, "SUBMIT", t=0.0, prompt_len=8)
+        rec.record(1, "QUEUED", t=0.0, depth=1)
+        rec.record(1, "ADMITTED", t=1.0, slot=0)
+        rec.record(1, "FIRST_TOKEN", t=1.5, chunks=1)
+        rec.record(1, "TOKEN_STREAMED", t=2.0, n=1,
+                   dispatch_t=1.6, wait_s=0.1)
+        rec.record(1, "RETIRED", t=2.25, tokens=2)
+        (b,) = rec.breakdowns()
+        assert b["queue_wait"] == pytest.approx(1.0)
+        assert b["prefill"] == pytest.approx(0.5)
+        # gap 0.5: launch in flight 0.4 (0.1 of it blocked on the fetch
+        # thread), 0.1 host gap + 0.25 retire tail = stall 0.35.
+        assert b["fetch_wait"] == pytest.approx(0.1)
+        assert b["decode_compute"] == pytest.approx(0.3)
+        assert b["scheduler_stall"] == pytest.approx(0.35)
+        assert b["swap"] == 0.0
+        assert b["wall"] == pytest.approx(2.25)
+        phases = sum(b[p] for p in ("queue_wait", "prefill",
+                                    "decode_compute", "fetch_wait",
+                                    "swap", "scheduler_stall"))
+        assert phases == pytest.approx(b["wall"])
+        assert rec.stats()["breakdown_sum_to_wall_ratio"] == \
+            pytest.approx(1.0)
+
+    def test_preempt_swap_resume_window(self):
+        rec = self._rec()
+        rec.record(2, "SUBMIT", t=0.0)
+        rec.record(2, "ADMITTED", t=1.0, slot=1)
+        rec.record(2, "FIRST_TOKEN", t=1.2)
+        rec.record(2, "PREEMPTED", t=1.5, path="swap")
+        rec.record(2, "SWAPPED_OUT", t=1.5, swap_bytes=4096)
+        rec.record(2, "SWAPPED_IN", t=2.4, swap_bytes=4096)
+        rec.record(2, "RESUMED", t=2.5, path="swap")
+        rec.record(2, "TOKEN_STREAMED", t=2.75, n=1, dispatch_t=2.55)
+        rec.record(2, "RETIRED", t=2.8)
+        (b,) = rec.breakdowns()
+        assert b["swap"] == pytest.approx(1.0)     # parked 1.5 -> 2.5
+        assert b["queue_wait"] == pytest.approx(1.0)
+        assert b["prefill"] == pytest.approx(0.2)
+        assert b["decode_compute"] == pytest.approx(0.2)
+        # eviction slice 0.3 + post-resume host gap 0.05 + tail 0.05
+        assert b["scheduler_stall"] == pytest.approx(0.4)
+        phases = sum(b[p] for p in ("queue_wait", "prefill",
+                                    "decode_compute", "fetch_wait",
+                                    "swap", "scheduler_stall"))
+        assert phases == pytest.approx(b["wall"]) == pytest.approx(2.8)
+        s = rec.stats()
+        assert s["ttft_breakdown_queue_wait_p99_ms"] == \
+            pytest.approx(1000.0)
+        assert s["ttft_breakdown_prefill_p99_ms"] == pytest.approx(200.0)
+
+    def test_recompute_readmission_closes_park(self):
+        rec = self._rec()
+        rec.record(3, "SUBMIT", t=0.0)
+        rec.record(3, "ADMITTED", t=0.5)
+        rec.record(3, "FIRST_TOKEN", t=0.7)
+        rec.record(3, "PREEMPTED", t=1.0, path="recompute")
+        rec.record(3, "ADMITTED", t=2.0, readmission=1)
+        rec.record(3, "RETIRED", t=2.1)
+        (b,) = rec.breakdowns()
+        assert b["swap"] == pytest.approx(1.0)     # parked 1.0 -> 2.0
+        assert b["queue_wait"] == pytest.approx(0.5)
+
+    def test_never_admitted_is_all_queue_wait(self):
+        rec = self._rec()
+        rec.record(4, "SUBMIT", t=0.0)
+        rec.record(4, "QUEUED", t=0.0, depth=9)
+        rec.record(4, "RETIRED", t=3.0)
+        (b,) = rec.breakdowns()
+        assert b["queue_wait"] == pytest.approx(3.0)
+        assert b["wall"] == pytest.approx(3.0)
+
+    def test_cancelled_excluded_from_aggregates(self):
+        rec = self._rec()
+        rec.record(5, "SUBMIT", t=0.0)
+        rec.record(5, "CANCELLED", t=1.0)
+        assert rec.breakdowns() == []
+        assert rec.live_requests() == 0
+        assert rec.stats()["lifecycle_requests_total"] == 1.0
+
+    def test_unknown_event_raises(self):
+        rec = self._rec()
+        with pytest.raises(ValueError, match="unknown lifecycle event"):
+            rec.record(1, "TELEPORTED")
+
+    def test_event_cap_counts_drops(self):
+        rec = self._rec(max_events_per_request=3)
+        rec.record(6, "SUBMIT", t=0.0)
+        rec.record(6, "ADMITTED", t=0.1)
+        rec.record(6, "FIRST_TOKEN", t=0.2)
+        for i in range(5):
+            rec.record(6, "TOKEN_STREAMED", t=0.3 + i * 0.1, n=1)
+        assert rec.stats()["lifecycle_dropped_total"] == 5.0
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "lifecycle.jsonl")
+        with self._rec(jsonl_path=path) as rec:
+            rec.record(7, "SUBMIT", t=0.0, prompt_len=4)
+            rec.record(7, "ADMITTED", t=0.5, slot=2)
+            rec.record(7, "RETIRED", t=1.0, tokens=3)
+        lines = [json.loads(x) for x in open(path).read().splitlines()]
+        assert [x["event"] for x in lines] == \
+            ["SUBMIT", "ADMITTED", "RETIRED"]
+        assert lines[0]["rid"] == 7 and lines[0]["prompt_len"] == 4
+        assert lines[1]["slot"] == 2
+
+    def test_thread_safety_smoke(self):
+        rec = self._rec()
+        errors = []
+
+        def worker(base):
+            try:
+                for i in range(200):
+                    rid = base * 1000 + i
+                    rec.record(rid, "SUBMIT", t=float(i))
+                    rec.record(rid, "ADMITTED", t=float(i) + 0.1)
+                    rec.record(rid, "RETIRED", t=float(i) + 0.2)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert rec.stats()["lifecycle_requests_total"] == 1600.0
+
+
+class TestTracerDropsAndFlows:
+    def test_ring_eviction_counts_dropped(self):
+        t = Tracer(capacity=4, enabled=True)
+        for i in range(10):
+            t.add_span(f"s{i}", start=float(i), end=float(i) + 0.5)
+        assert t.dropped_events == 6
+        s = t.stats()
+        assert s["trace_events"] == 4.0
+        assert s["trace_dropped_events"] == 6.0
+        t.clear()
+        assert t.dropped_events == 0
+
+    def test_disabled_tracer_drops_nothing(self):
+        t = Tracer(capacity=2)
+        for i in range(5):
+            t.add_instant(f"i{i}")
+        assert t.dropped_events == 0 and len(t) == 0
+
+    def test_flow_events_link_lanes(self, tmp_path):
+        t = Tracer(enabled=True)
+        t.add_flow("request", id=7, phase="s", cat="gateway",
+                   tid=7, t=1.0)
+        t.add_flow("request", id=7, phase="f", cat="serve", tid=7, t=2.0)
+        evs = t.events()
+        assert [e["ph"] for e in evs] == ["s", "f"]
+        assert all(e["id"] == 7 for e in evs)
+        assert evs[1]["bp"] == "e" and "bp" not in evs[0]
+        path = str(tmp_path / "flow.json")
+        assert t.write(path) == 2
+        doc = json.load(open(path))
+        flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+        assert len(flows) == 2
+
+    def test_flow_rejects_bad_phase(self):
+        t = Tracer(enabled=True)
+        with pytest.raises(ValueError, match="flow phase"):
+            t.add_flow("request", id=1, phase="x")
+
+
+class TestMetricsServerConcurrentScrape:
+    """A scrape that lands mid-write must still render a complete,
+    valid Prometheus text page — 8 writer threads hammer the registry
+    while 8 scraper threads pull /metrics."""
+
+    _LINE = __import__("re").compile(
+        r"^(#.*|[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? "
+        r"[-+0-9.eE]+(inf|nan)?)$")
+
+    def test_mid_write_scrape_is_valid_text(self):
+        r = Registry()
+        c = r.counter("dtt_stress_total", "stress counter",
+                      labelnames=("worker",))
+        h = r.histogram("dtt_stress_seconds", "stress histogram",
+                        buckets=(0.01, 0.1, 1.0))
+        stop = threading.Event()
+        errors = []
+
+        def writer(k):
+            i = 0
+            while not stop.is_set():
+                c.labels(worker=str(k)).inc()
+                h.observe((i % 100) / 50.0)
+                i += 1
+
+        with MetricsServer(port=0, registry=r, host="127.0.0.1") as srv:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+
+            def scraper():
+                try:
+                    for _ in range(12):
+                        body = urllib.request.urlopen(
+                            url, timeout=10).read().decode()
+                        assert body.endswith("\n")
+                        for ln in body.splitlines():
+                            assert self._LINE.match(ln), ln
+                        assert "dtt_stress_seconds_count" in body
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            writers = [threading.Thread(target=writer, args=(k,),
+                                        daemon=True) for k in range(8)]
+            scrapers = [threading.Thread(target=scraper)
+                        for _ in range(8)]
+            for t in writers + scrapers:
+                t.start()
+            for t in scrapers:
+                t.join(timeout=60)
+            stop.set()
+            for t in writers:
+                t.join(timeout=5)
+        assert not errors, errors
